@@ -1,0 +1,75 @@
+"""Benchmark: simulated-clients x rounds / sec (BASELINE.md north star).
+
+Workload: FedAvg, CIFAR-10-shaped data (local .npz if present, deterministic
+surrogate otherwise — same shapes/FLOPs either way), CNN, IID clients, 1 local
+epoch per round — the reference's headline configuration
+(BASELINE.json configs[0]) at benchmark scale.
+
+North star: 1000 clients x 100 rounds < 5 min on a v5e-8 pod, i.e.
+333.3 clients*rounds/sec across 8 chips (41.7 per chip).
+``vs_baseline`` reports this bench's rate against the FULL 333.3 pod-rate
+even when running on a single chip.
+
+Prints ONE JSON line. Env overrides: BENCH_CLIENTS, BENCH_ROUNDS,
+BENCH_MODEL, BENCH_BATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def main():
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.data.registry import get_dataset
+    from distributed_learning_simulator_tpu.simulator import (
+        build_client_data,
+        run_simulation,
+    )
+
+    n_clients = int(os.environ.get("BENCH_CLIENTS", "100"))
+    n_rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+    model = os.environ.get("BENCH_MODEL", "cnn")
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+
+    config = ExperimentConfig(
+        dataset_name="cifar10",
+        model_name=model,
+        distributed_algorithm="fed",
+        worker_number=n_clients,
+        round=n_rounds + 1,  # round 0 carries the XLA compile; dropped below
+        epoch=1,
+        learning_rate=0.1,
+        momentum=0.9,
+        batch_size=batch,
+        log_level="WARNING",
+        eval_batch_size=1024,
+    )
+    dataset = get_dataset(config.dataset_name, seed=config.seed)
+    client_data = build_client_data(config, dataset)
+
+    result = run_simulation(config, dataset=dataset, client_data=client_data,
+                            setup_logging=False)
+    # Steady-state rate: drop round 0 (jit compile of the round + eval
+    # programs happens there, inside the same jitted callables the later
+    # rounds reuse).
+    steady = [h["round_seconds"] for h in result["history"][1:]]
+    elapsed = sum(steady)
+
+    value = n_clients * n_rounds / elapsed
+    north_star = 1000 * 100 / 300.0  # 333.3 clients*rounds/sec on v5e-8
+    print(json.dumps({
+        "metric": "simulated_clients_x_rounds_per_sec",
+        "value": round(value, 2),
+        "unit": "clients*rounds/s",
+        "vs_baseline": round(value / north_star, 3),
+        "clients": n_clients,
+        "rounds": n_rounds,
+        "elapsed_s": round(elapsed, 2),
+        "final_accuracy": result["final_accuracy"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""distributed_learning_simulator_tpu — a TPU-native federated-learning simulator.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``chen-zichen/distributed_learning_simulator`` (reference mounted at
+``/root/reference``): synchronous federated learning with one logical server and
+N simulated clients, five distributed algorithms (FedAvg, SignSGD majority
+vote, quantized FedAvg, exact multi-round Shapley contribution scoring, and
+GTG-Shapley Monte-Carlo scoring), heterogeneous/non-IID client data, and
+compression-ratio accounting.
+
+Design stance (not a port):
+  * The reference simulates clients with one OS thread each and a blocking
+    queue (reference simulator.py:60-69, servers/server.py:10-17). Here the
+    client population is a *stacked leading axis* over the params pytree; a
+    full round (local training on every client + aggregation + broadcast) is
+    ONE jitted XLA program. "Communication" is array data flow: gather/average/
+    broadcast collapse into reductions over the client axis, which XLA lowers
+    to ICI collectives when the axis is sharded over a ``jax.sharding.Mesh``.
+  * Server classes (reference servers/*.py) survive only as the algorithm
+    strategy interface — see ``algorithms/base.py``.
+"""
+
+__version__ = "0.1.0"
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig, get_config
+from distributed_learning_simulator_tpu.factory import get_algorithm, registered_algorithms
+
+__all__ = [
+    "ExperimentConfig",
+    "get_config",
+    "get_algorithm",
+    "registered_algorithms",
+    "__version__",
+]

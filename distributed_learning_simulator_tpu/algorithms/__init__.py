@@ -1,0 +1,20 @@
+from distributed_learning_simulator_tpu.algorithms.base import Algorithm, RoundContext
+from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
+from distributed_learning_simulator_tpu.algorithms.sign_sgd import SignSGD
+from distributed_learning_simulator_tpu.algorithms.fed_quant import FedQuant
+from distributed_learning_simulator_tpu.algorithms.shapley import (
+    MultiRoundShapley,
+    GTGShapley,
+    shapley_from_utilities,
+)
+
+__all__ = [
+    "Algorithm",
+    "RoundContext",
+    "FedAvg",
+    "SignSGD",
+    "FedQuant",
+    "MultiRoundShapley",
+    "GTGShapley",
+    "shapley_from_utilities",
+]

@@ -1,0 +1,80 @@
+"""Algorithm strategy interface.
+
+What survives of the reference's server/worker class split
+(reference servers/*.py + workers/*.py + factory.py:14-35): an algorithm is a
+strategy object that
+
+  * builds a jitted **round function** — the whole synchronous round
+    (broadcast -> N local trainings -> gather -> aggregate) as ONE XLA
+    program over client-stacked arrays; and
+  * optionally runs a host-side **post_round** hook — for work that is
+    genuinely data-dependent control flow (Shapley convergence loops,
+    reference GTG_shapley_value_server.py:36) or pure logging/persistence.
+
+The reference's template-method hooks ``_process_client_parameter`` /
+``_process_aggregated_parameter`` (servers/fed_server.py:38-42) survive as
+the jax-level hooks ``process_client_payload`` / ``process_aggregated`` on
+:class:`~distributed_learning_simulator_tpu.algorithms.fedavg.FedAvg`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+
+
+@dataclass
+class RoundContext:
+    """Everything a host-side post_round hook may need for one round."""
+
+    round_idx: int  # 0-based
+    global_params: Any  # aggregated params after this round
+    prev_global_params: Any  # global params before this round
+    sizes: Any  # [n_clients] aggregation weights
+    aux: dict  # round_fn diagnostics (may hold 'client_params')
+    metrics: dict  # server-side eval of global_params {'loss','accuracy'}
+    prev_metrics: dict | None  # eval of prev_global_params (previous round)
+    eval_batches: tuple  # (xb, yb, mb) padded test set on device
+    log_dir: str | None
+    extra: dict = field(default_factory=dict)
+
+
+class Algorithm:
+    """Base strategy. Subclasses set ``name`` (registry key, parity with
+    reference factory.py:14-35) and implement ``make_round_fn``."""
+
+    name: str = ""
+    # Shapley algorithms need the stacked per-client params in round output.
+    keep_client_params: bool = False
+
+    def __init__(self, config):
+        self.config = config
+
+    # ---- jit side ----------------------------------------------------------
+    def make_round_fn(
+        self, apply_fn: Callable, optimizer, n_clients: int
+    ) -> Callable:
+        """Return ``round_fn(global_params, client_state, cx, cy, cmask,
+        sizes, key) -> (new_global, new_client_state, aux)``.
+
+        ``client_state`` is whatever per-client state persists across rounds
+        (optimizer/momentum buffers) as a client-stacked pytree; ``aux`` is a
+        dict of diagnostics (device arrays).
+        """
+        raise NotImplementedError
+
+    def init_client_state(self, optimizer, global_params, n_clients):
+        """Initial per-client persistent state (client-stacked pytree)."""
+        return jax.vmap(lambda _: optimizer.init(global_params))(
+            jax.numpy.arange(n_clients)
+        )
+
+    # ---- host side ---------------------------------------------------------
+    def prepare(self, apply_fn, eval_fn) -> None:
+        """One-time setup after the engine is built (e.g. jit subset-eval)."""
+
+    def post_round(self, ctx: RoundContext) -> dict:
+        """Host-side per-round hook; returns extra metrics to record/log."""
+        return {}

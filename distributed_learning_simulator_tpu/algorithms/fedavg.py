@@ -1,0 +1,78 @@
+"""FedAvg: dataset-size-weighted federated averaging.
+
+Replaces the reference's FedServer/FedWorker pair (servers/fed_server.py,
+workers/fed_worker.py). One round = one jitted program:
+
+  broadcast global params (vmap in_axes=None — the RepeatedResult broadcast of
+  fed_server.py:19-24) -> vmap'd local training, E epochs each
+  (fed_worker.py:25-27) -> dataset-size-weighted average over the client axis
+  (fed_server.py:44-66,81) -> hooks.
+
+The queue barrier (fed_server.py:75-77) is implicit: a jitted program's
+aggregation consumes all clients' outputs by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_learning_simulator_tpu.algorithms.base import Algorithm
+from distributed_learning_simulator_tpu.ops.aggregate import weighted_mean
+from distributed_learning_simulator_tpu.parallel.engine import make_local_train_fn
+
+
+class FedAvg(Algorithm):
+    name = "fed"
+
+    # jax-level template hooks, parity with fed_server.py:38-42 -------------
+    def process_client_payload(self, client_params, key):
+        """Per-client payload transform before aggregation (identity here;
+        FedQuant overrides with quantize->dequantize)."""
+        return client_params, {}
+
+    def process_aggregated(self, global_params, key):
+        """Aggregated-params transform (identity; FedQuant quantizes the
+        broadcast). Returns (params, extra_aux)."""
+        return global_params, {}
+
+    def make_round_fn(self, apply_fn, optimizer, n_clients: int):
+        cfg = self.config
+        local_train = make_local_train_fn(
+            apply_fn,
+            optimizer,
+            local_epochs=cfg.epoch,
+            batch_size=cfg.batch_size,
+            param_transform=self.client_param_transform(),
+            reset_optimizer=cfg.reset_client_optimizer,
+        )
+        vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
+        keep = self.keep_client_params
+
+        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
+            train_key, payload_key, agg_key = jax.random.split(key, 3)
+            client_keys = jax.random.split(train_key, n_clients)
+            client_params, new_state, train_metrics = vtrain(
+                global_params, client_state, cx, cy, cmask, client_keys
+            )
+            client_params, payload_aux = self.process_client_payload(
+                client_params, payload_key
+            )
+            new_global = weighted_mean(client_params, sizes)
+            new_global, agg_aux = self.process_aggregated(new_global, agg_key)
+            aux = {
+                "client_loss": train_metrics["loss"],
+                "client_accuracy": train_metrics["accuracy"],
+                "mean_client_loss": jnp.mean(train_metrics["loss"]),
+                **payload_aux,
+                **agg_aux,
+            }
+            if keep:
+                aux["client_params"] = client_params
+            return new_global, new_state, aux
+
+        return round_fn
+
+    def client_param_transform(self):
+        """Param transform inside the client loss (QAT hook; None here)."""
+        return None

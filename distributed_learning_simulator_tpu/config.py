@@ -1,0 +1,102 @@
+"""Experiment configuration + CLI.
+
+Parity with the reference's config surface (config.py:9-18 adds
+``--distributed_algorithm --worker_number --round`` on top of the external
+``DefaultConfig``'s ``--dataset_name --model_name --epoch --learning_rate
+--optimizer_name --log_level`` — observed at simulator.sh:1-2), plus the
+knobs this framework adds natively: partitioning (IID / Dirichlet), mesh
+size, quantization levels, Shapley hyperparameters, checkpointing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ExperimentConfig:
+    # --- reference-parity flags (config.py:16-18, simulator.sh:1-2) --------
+    dataset_name: str = "mnist"
+    model_name: str = "lenet5"
+    distributed_algorithm: str = "fed"
+    worker_number: int = 4
+    round: int = 10
+    epoch: int = 2  # local epochs per round
+    learning_rate: float = 0.01
+    optimizer_name: str = "SGD"
+    log_level: str = "INFO"
+    dataset_args: dict[str, Any] = field(default_factory=dict)
+
+    # --- training ----------------------------------------------------------
+    batch_size: int = 32
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    dampening: float = 0.0
+    nesterov: bool = False
+    seed: int = 0
+    reset_client_optimizer: bool = True
+
+    # --- data partitioning (data/partition.py) -----------------------------
+    partition: str = "iid"  # iid | dirichlet
+    dirichlet_alpha: float = 0.1
+    n_train: int | None = None  # subsample for fast runs/tests
+    n_test: int | None = None
+    data_dir: str | None = None
+
+    # --- quantization (algorithms/fed_quant.py) ----------------------------
+    quant_levels: int = 256
+    qat: bool = True
+
+    # --- Shapley (algorithms/shapley.py) ------------------------------------
+    round_trunc_threshold: float | None = None
+    gtg_eps: float = 1e-3
+    gtg_last_k: int = 10
+    gtg_converge_criteria: float = 0.05
+    gtg_max_permutations: int = 500
+
+    # --- execution ----------------------------------------------------------
+    mesh_devices: int | None = None  # None = single-device vmap path
+    eval_batch_size: int = 512
+    log_root: str = "log"
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # rounds; 0 = disabled
+    resume: bool = False
+
+    def validate(self) -> "ExperimentConfig":
+        if self.worker_number < 1:
+            raise ValueError("worker_number must be >= 1")
+        if self.round < 1:
+            raise ValueError("round must be >= 1")
+        if self.partition not in ("iid", "dirichlet"):
+            raise ValueError(f"unknown partition {self.partition!r}")
+        return self
+
+
+def _add_args(parser: argparse.ArgumentParser) -> None:
+    for f in dataclasses.fields(ExperimentConfig):
+        if f.name == "dataset_args":
+            continue
+        arg = f"--{f.name}"
+        if f.type in ("bool", bool):
+            parser.add_argument(arg, type=lambda s: s.lower() in ("1", "true"),
+                                default=f.default)
+        elif f.name in ("n_train", "n_test", "mesh_devices"):
+            parser.add_argument(arg, type=int, default=None)
+        elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir"):
+            typ = float if f.name == "round_trunc_threshold" else str
+            parser.add_argument(arg, type=typ, default=None)
+        else:
+            parser.add_argument(arg, type=type(f.default), default=f.default)
+
+
+def get_config(args: list[str] | None = None) -> ExperimentConfig:
+    """Parse CLI args into an ExperimentConfig (reference config.py:22-25)."""
+    parser = argparse.ArgumentParser(
+        description="TPU-native distributed learning simulator"
+    )
+    _add_args(parser)
+    ns = parser.parse_args(args)
+    return ExperimentConfig(**vars(ns)).validate()

@@ -1,0 +1,16 @@
+from distributed_learning_simulator_tpu.data.registry import Dataset, get_dataset
+from distributed_learning_simulator_tpu.data.partition import (
+    iid_partition,
+    dirichlet_partition,
+    pack_client_shards,
+    ClientData,
+)
+
+__all__ = [
+    "Dataset",
+    "get_dataset",
+    "iid_partition",
+    "dirichlet_partition",
+    "pack_client_shards",
+    "ClientData",
+]

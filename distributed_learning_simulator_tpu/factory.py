@@ -1,0 +1,41 @@
+"""Algorithm registry: string -> strategy class.
+
+Parity with the reference's ``factory.py`` dispatch (factory.py:14-35): the
+same five registry names select the same five algorithms; unknown names raise
+(factory.py:25,35). Where the reference returns separate server/worker
+classes, here one strategy object owns both sides of the round (see
+algorithms/base.py).
+"""
+
+from __future__ import annotations
+
+from distributed_learning_simulator_tpu.algorithms.fed_quant import FedQuant
+from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
+from distributed_learning_simulator_tpu.algorithms.shapley import (
+    GTGShapley,
+    MultiRoundShapley,
+)
+from distributed_learning_simulator_tpu.algorithms.sign_sgd import SignSGD
+
+_ALGORITHMS = {
+    "fed": FedAvg,
+    "sign_SGD": SignSGD,
+    "fed_quant": FedQuant,
+    "multiround_shapley_value": MultiRoundShapley,
+    "GTG_shapley_value": GTGShapley,
+}
+
+
+def registered_algorithms():
+    return sorted(_ALGORITHMS)
+
+
+def get_algorithm(name: str, config):
+    """Instantiate the algorithm strategy for ``name`` (reference registry
+    names, factory.py:14-35)."""
+    if name not in _ALGORITHMS:
+        raise RuntimeError(
+            f"unknown distributed algorithm {name!r}; "
+            f"registered: {registered_algorithms()}"
+        )
+    return _ALGORITHMS[name](config)

@@ -1,0 +1,7 @@
+from distributed_learning_simulator_tpu.models.registry import (
+    get_model,
+    registered_models,
+    init_params,
+)
+
+__all__ = ["get_model", "registered_models", "init_params"]

@@ -1,0 +1,45 @@
+"""Small CNN for 32x32 RGB inputs (CIFAR-10 class of workloads).
+
+Covers the reference baseline config "FedAvg, 10 clients, CIFAR-10 CNN"
+(BASELINE.json configs[0]). Also includes a tiny MLP used by tests.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CifarCNN(nn.Module):
+    num_classes: int = 10
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = nn.Conv(features=w, kernel_size=(3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.Conv(features=w * 2, kernel_size=(3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.Conv(features=w * 4, kernel_size=(3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(features=w * 8)(x)
+        x = nn.relu(x)
+        x = nn.Dense(features=self.num_classes)(x)
+        return x.astype(jnp.float32)
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(features=self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(features=self.num_classes)(x)
+        return x.astype(jnp.float32)

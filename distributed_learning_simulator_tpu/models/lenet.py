@@ -1,0 +1,31 @@
+"""LeNet-5 for 28x28 grayscale inputs.
+
+The reference's canonical smoke-test model (``--model_name LeNet5`` in
+reference simulator.sh:1, provided there by the external model registry).
+NHWC layout, ReLU activations, bfloat16-friendly conv/dense sizes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(features=6, kernel_size=(5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.Conv(features=16, kernel_size=(5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(features=120)(x)
+        x = nn.relu(x)
+        x = nn.Dense(features=84)(x)
+        x = nn.relu(x)
+        x = nn.Dense(features=self.num_classes)(x)
+        return x.astype(jnp.float32)

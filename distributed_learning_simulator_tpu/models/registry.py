@@ -1,0 +1,49 @@
+"""Model registry: name -> flax module.
+
+TPU-native replacement of the external model registry the reference leans on
+(``--model_name`` flag, reference simulator.sh:1, resolved inside the external
+``DefaultConfig.create_trainer``, reference simulator.py:47). Names are
+case-insensitive; "lenet5" matches the reference launch script.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_learning_simulator_tpu.models.cnn import MLP, CifarCNN
+from distributed_learning_simulator_tpu.models.lenet import LeNet5
+from distributed_learning_simulator_tpu.models.resnet import ResNet18
+
+_MODELS = {
+    "lenet5": LeNet5,
+    "cnn": CifarCNN,
+    "cifarcnn": CifarCNN,
+    "resnet18": ResNet18,
+    "mlp": MLP,
+}
+
+
+def registered_models():
+    return sorted(set(_MODELS))
+
+
+def get_model(name: str, num_classes: int = 10, **kwargs):
+    """Instantiate a model by registry name."""
+    key = name.lower().replace("-", "").replace("_", "")
+    if key not in _MODELS:
+        raise ValueError(
+            f"unknown model {name!r}; registered: {registered_models()}"
+        )
+    return _MODELS[key](num_classes=num_classes, **kwargs)
+
+
+def init_params(model, sample_input, seed: int = 0):
+    """Initialize model params from a sample batch (pure-params models only)."""
+    variables = model.init(jax.random.key(seed), jnp.asarray(sample_input))
+    if set(variables.keys()) != {"params"}:
+        raise ValueError(
+            "models must be pure functions of params (no mutable collections); "
+            f"got {sorted(variables.keys())}"
+        )
+    return variables["params"]

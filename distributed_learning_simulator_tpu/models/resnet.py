@@ -1,0 +1,70 @@
+"""ResNet-18 with GroupNorm, NHWC, for 32x32 inputs.
+
+Flagship model for the scale config "non-IID Dirichlet(0.1), 1000 clients,
+ResNet-18" (BASELINE.json configs[4]). Deliberate TPU/FL design choice:
+GroupNorm instead of BatchNorm — BatchNorm's running statistics are mutable
+non-parameter state that (a) breaks the pure client-stacked-params discipline
+under ``vmap`` and (b) is known to degrade under federated averaging of
+per-client statistics; GroupNorm keeps the model a pure function of params.
+Convs run in bfloat16 on the MXU; logits returned float32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResidualBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", use_bias=False, dtype=self.dtype,
+        )(x)
+        y = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
+        )(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, dtype=self.dtype,
+            )(residual)
+            residual = nn.GroupNorm(
+                num_groups=min(32, self.features), dtype=self.dtype
+            )(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    num_classes: int = 10
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        # CIFAR-style stem (3x3, no initial downsample) for 32x32 inputs.
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.width), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2**stage)
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResidualBlock(features, strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

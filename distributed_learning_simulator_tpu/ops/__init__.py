@@ -1,0 +1,38 @@
+from distributed_learning_simulator_tpu.ops.aggregate import (
+    weighted_mean,
+    subset_weighted_mean,
+    subset_masks_all,
+)
+from distributed_learning_simulator_tpu.ops.sign import sign_compress, majority_vote
+from distributed_learning_simulator_tpu.ops.quantize import (
+    stochastic_quantize,
+    dequantize,
+    stochastic_quantize_tree,
+    dequantize_tree,
+    fake_quant,
+    fake_quant_tree,
+)
+from distributed_learning_simulator_tpu.ops.payload import (
+    payload_bytes,
+    quantized_payload_bytes,
+    sign_payload_bytes,
+    compression_ratio,
+)
+
+__all__ = [
+    "weighted_mean",
+    "subset_weighted_mean",
+    "subset_masks_all",
+    "sign_compress",
+    "majority_vote",
+    "stochastic_quantize",
+    "dequantize",
+    "stochastic_quantize_tree",
+    "dequantize_tree",
+    "fake_quant",
+    "fake_quant_tree",
+    "payload_bytes",
+    "quantized_payload_bytes",
+    "sign_payload_bytes",
+    "compression_ratio",
+]

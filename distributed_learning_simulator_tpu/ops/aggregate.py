@@ -1,0 +1,79 @@
+"""Aggregation ops over the client axis.
+
+Each function here replaces a reference *server class* hot loop with a pure
+function over client-stacked pytrees (every leaf has leading dim = n_clients):
+
+  * :func:`weighted_mean` — dataset-size-weighted FedAvg aggregation
+    (reference servers/fed_server.py:44-66,81: per-tensor weighted sum over N
+    buffered client param dicts).
+  * :func:`subset_weighted_mean` — weighted average over an arbitrary client
+    subset given as a 0/1 mask, empty subset falling back to the previous
+    global model (reference servers/fed_server.py:44-47 ``get_subset_model``,
+    the Shapley workhorse). Mask form makes the op fixed-shape, so thousands
+    of subsets batch under ``vmap`` (reference instead loops Python subsets,
+    multiround_shapley_value_server.py:34-40).
+
+On a sharded client axis these reductions are lowered by XLA to ICI
+collectives — the TPU-native equivalent of the reference's queue
+barrier + broadcast (servers/fed_server.py:75-91).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def weighted_mean(stacked_tree, weights):
+    """Weighted average over the leading (client) axis of every leaf.
+
+    ``weights`` is ``[n_clients]`` (e.g. per-client dataset sizes, parity with
+    fed_server.py:58-66); they are normalized internally.
+    """
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    w = weights / jnp.sum(weights)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.tensordot(w.astype(x.dtype), x, axes=(0, 0)), stacked_tree
+    )
+
+
+def subset_weighted_mean(stacked_tree, weights, mask, fallback_tree):
+    """Weighted average over the clients selected by ``mask`` (0/1, [n_clients]).
+
+    Empty subset returns ``fallback_tree`` (the previous global model), parity
+    with reference fed_server.py:45-47. Fixed-shape in ``mask``, so it can be
+    ``vmap``-ed over a batch of subset masks for Shapley evaluation.
+    """
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    mask = jnp.asarray(mask, dtype=jnp.float32)
+    mw = weights * mask
+    total = jnp.sum(mw)
+    safe_total = jnp.where(total > 0, total, 1.0)
+    norm = mw / safe_total
+    nonempty = total > 0
+
+    def _leaf(x, fb):
+        avg = jnp.tensordot(norm.astype(x.dtype), x, axes=(0, 0))
+        return jnp.where(nonempty, avg, fb)
+
+    return jax.tree_util.tree_map(_leaf, stacked_tree, fallback_tree)
+
+
+def subset_masks_all(n_clients: int, include_empty: bool = True) -> np.ndarray:
+    """All 2^N subset masks as a ``[2^N, N]`` 0/1 array (host-side helper).
+
+    Replaces the reference's ``powerset`` iterator
+    (servers/shapley_value_server.py:11-14) with a fixed-shape mask batch for
+    ``vmap``. Row order: subsets sorted by (size, lexicographic), empty first.
+    """
+    ids = list(range(n_clients))
+    rows = []
+    for r in range(0 if include_empty else 1, n_clients + 1):
+        for combo in itertools.combinations(ids, r):
+            row = np.zeros((n_clients,), dtype=np.float32)
+            row[list(combo)] = 1.0
+            rows.append(row)
+    return np.stack(rows)

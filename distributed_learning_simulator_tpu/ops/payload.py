@@ -1,0 +1,41 @@
+"""Analytic communication-payload accounting.
+
+The reference measures compression ratios by pickling tensors and comparing
+byte counts (servers/fed_quant_server.py:41-48, workers/fed_quant_worker.py:
+42-50). On TPU nothing is serialized — clients and server live in one XLA
+program — so payload size is defined *analytically*: bits-per-element x numel
+plus per-tensor metadata. This keeps the reference's compression-ratio logs
+(semantics parity) without host round-trips.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from distributed_learning_simulator_tpu.utils.tree import tree_bytes
+
+
+def payload_bytes(tree) -> int:
+    """Uncompressed payload size: every leaf at its native dtype width."""
+    return tree_bytes(tree)
+
+
+def quantized_payload_bytes(tree, levels: int) -> int:
+    """Size of the same pytree quantized to ``levels`` levels.
+
+    ceil(log2(levels)) bits per element, plus 8 bytes (scale + zero_point as
+    float32) per tensor of metadata.
+    """
+    bits = max(1, (levels - 1).bit_length())
+    n_tensors = len(jax.tree_util.tree_leaves(tree))
+    return tree_bytes(tree, bits_per_element=bits) + 8 * n_tensors
+
+
+def sign_payload_bytes(tree) -> int:
+    """1-bit-per-element sign payload (SignSGD uploads)."""
+    return tree_bytes(tree, bits_per_element=1)
+
+
+def compression_ratio(original_bytes: int, compressed_bytes: int) -> float:
+    """original/compressed, parity with the reference's ratio logs."""
+    return original_bytes / max(1, compressed_bytes)

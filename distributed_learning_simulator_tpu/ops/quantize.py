@@ -1,0 +1,99 @@
+"""Quantization ops: stochastic payload quantization + fake-quant (QAT).
+
+TPU-native replacement for the reference's quantization stack:
+
+  * ``stochastic_quantization(level)`` from the external lib (reference
+    servers/fed_quant_server.py:2-3,37-39) -> :func:`stochastic_quantize` /
+    :func:`dequantize`: affine quantization to ``levels`` levels with
+    *stochastic rounding*, unbiased in expectation.
+  * PyTorch's ``QuantizationAwareTraining`` + ``QuantStub`` machinery
+    (reference workers/fed_quant_worker.py:19-20, quant_model.py:4-19) has no
+    JAX twin; QAT here is :func:`fake_quant` — a straight-through-estimator
+    round-trip applied to parameters inside the loss, which is the idiomatic
+    XLA formulation (elementwise ops fused into the training step).
+
+Everything is elementwise and jit/vmap-safe; the quantized representation is
+``(codes, scale, zero_point)`` with ``dequant = (codes - zero_point) * scale``,
+matching the reference's dequantization formula (fed_quant_server.py:25-33).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    """Affine-quantized tensor: ``value ~= (codes - zero_point) * scale``."""
+
+    codes: jax.Array  # float32 integer-valued codes in [0, levels-1]
+    scale: jax.Array  # scalar
+    zero_point: jax.Array  # scalar, in the quantized domain
+
+
+def _affine_params(x, levels: int):
+    xmin = jnp.min(x)
+    xmax = jnp.max(x)
+    span = xmax - xmin
+    scale = jnp.where(span > 0, span / (levels - 1), 1.0)
+    zero_point = -xmin / scale
+    return scale, zero_point
+
+
+def stochastic_quantize(x, levels: int, key) -> QuantizedTensor:
+    """Quantize ``x`` to ``levels`` levels with stochastic rounding.
+
+    Unbiased: ``E[dequantize(stochastic_quantize(x))] = x``. Parity with the
+    external ``stochastic_quantization`` used at fed_quant_server.py:37-39
+    (256 levels = 8-bit).
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    scale, zero_point = _affine_params(x, levels)
+    normalized = x / scale + zero_point
+    floor = jnp.floor(normalized)
+    frac = normalized - floor
+    up = jax.random.bernoulli(key, frac.astype(jnp.float32))
+    codes = jnp.clip(floor + up.astype(jnp.float32), 0, levels - 1)
+    return QuantizedTensor(codes=codes, scale=scale, zero_point=zero_point)
+
+
+def dequantize(q: QuantizedTensor) -> jax.Array:
+    """Inverse affine map (reference fed_quant_server.py:31-33)."""
+    return (q.codes - q.zero_point) * q.scale
+
+
+def stochastic_quantize_tree(tree, levels: int, key):
+    """Per-leaf stochastic quantization of a whole params pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    q_leaves = [stochastic_quantize(x, levels, k) for x, k in zip(leaves, keys)]
+    return jax.tree_util.tree_unflatten(treedef, q_leaves)
+
+
+def dequantize_tree(q_tree):
+    """Per-leaf dequantization; inverse of :func:`stochastic_quantize_tree`."""
+    return jax.tree_util.tree_map(
+        dequantize, q_tree, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+
+
+def fake_quant(x, levels: int):
+    """Deterministic quantize->dequantize with a straight-through gradient.
+
+    Forward: nearest-level affine round-trip. Backward: identity (STE).
+    This is the QAT primitive replacing PyTorch's fake-quant observers
+    (reference quant_model.py:9-11); applying it to params inside the loss
+    trains a model robust to ``levels``-level parameter quantization.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    scale, zero_point = _affine_params(jax.lax.stop_gradient(x), levels)
+    codes = jnp.clip(jnp.round(x / scale + zero_point), 0, levels - 1)
+    dq = (codes - zero_point) * scale
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+def fake_quant_tree(tree, levels: int):
+    """Apply :func:`fake_quant` to every leaf of a params pytree."""
+    return jax.tree_util.tree_map(lambda x: fake_quant(x, levels), tree)

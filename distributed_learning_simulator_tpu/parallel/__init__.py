@@ -1,0 +1,25 @@
+from distributed_learning_simulator_tpu.parallel.mesh import (
+    make_mesh,
+    client_sharding,
+    replicated_sharding,
+    shard_client_data,
+)
+from distributed_learning_simulator_tpu.parallel.engine import (
+    make_loss_fn,
+    make_local_train_fn,
+    make_eval_fn,
+    pad_eval_set,
+    make_optimizer,
+)
+
+__all__ = [
+    "make_mesh",
+    "client_sharding",
+    "replicated_sharding",
+    "shard_client_data",
+    "make_loss_fn",
+    "make_local_train_fn",
+    "make_eval_fn",
+    "pad_eval_set",
+    "make_optimizer",
+]

@@ -1,0 +1,177 @@
+"""Client-axis training engine: local training as scan, clients as vmap.
+
+Replaces the reference's per-worker ``Trainer`` objects driven by one OS
+thread each (reference workers/fed_worker.py:19-27: block for global params,
+run E local epochs, ship params). Here a client's local training run is a
+pure function
+
+    local_train(params, shard_x, shard_y, mask, key) -> (params', metrics)
+
+built as ``lax.scan`` over epochs x steps (compiler-friendly: static shapes,
+no Python control flow inside jit), and the whole client population is
+``vmap(local_train)`` — N clients train in lockstep as one batched XLA
+program, with every matmul carrying the client axis as an extra batch
+dimension onto the MXU.
+
+Padding discipline: shards are fixed-size with 0/1 sample masks
+(data/partition.py); masked samples contribute zero loss and zero gradient,
+so Dirichlet/heterogeneous shards need no recompilation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def make_optimizer(name: str, learning_rate: float, momentum: float = 0.0,
+                   weight_decay: float = 0.0):
+    """Optimizer registry, parity with the reference's ``--optimizer_name``
+    flag (reference simulator.sh:1; SGD is the reference default and the
+    required optimizer for SignSGD, sign_sgd_worker.py:14)."""
+    key = name.lower()
+    if key == "sgd":
+        tx = optax.sgd(learning_rate, momentum=momentum or None)
+    elif key == "adam":
+        tx = optax.adam(learning_rate)
+    elif key == "adamw":
+        tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if weight_decay and key == "sgd":
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def make_loss_fn(apply_fn, param_transform: Callable | None = None):
+    """Masked softmax cross-entropy + accuracy.
+
+    ``param_transform`` hooks QAT: e.g. ``fake_quant_tree`` applied to params
+    inside the loss gives straight-through-estimator quantization-aware
+    training (replaces reference workers/fed_quant_worker.py:19-20).
+    """
+
+    def loss_fn(params, x, y, mask):
+        p = param_transform(params) if param_transform is not None else params
+        logits = apply_fn({"params": p}, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        loss = jnp.sum(nll * mask) / denom
+        acc = jnp.sum((jnp.argmax(logits, axis=1) == y) * mask) / denom
+        return loss, acc
+
+    return loss_fn
+
+
+def make_local_train_fn(
+    apply_fn,
+    optimizer,
+    local_epochs: int,
+    batch_size: int,
+    param_transform: Callable | None = None,
+    reset_optimizer: bool = True,
+):
+    """Build ``local_train(params, opt_state, xs, ys, mask, key)``.
+
+    E epochs over the client's fixed-size shard, fresh random permutation per
+    epoch, minibatches of ``batch_size`` (shard_size must be a multiple —
+    data/partition.py guarantees it). Matches the reference hot loop
+    ``for _ in range(E): epoch of SGD`` (external Trainer.train called at
+    fed_worker.py:25-27) but as two nested ``lax.scan``s.
+
+    vmap over the client axis: ``jax.vmap(local_train, in_axes=(None, 0, 0,
+    0, 0, 0))`` — global params broadcast (the init-model broadcast of
+    fed_server.py:19-24), everything else per-client.
+    """
+    loss_fn = make_loss_fn(apply_fn, param_transform)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local_train(params, opt_state, xs, ys, mask, key):
+        shard_size = xs.shape[0]
+        steps_per_epoch = shard_size // batch_size
+        if reset_optimizer:
+            opt_state = optimizer.init(params)
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_key, shard_size)
+
+            def step_body(carry, step):
+                params, opt_state = carry
+                idx = jax.lax.dynamic_slice_in_dim(
+                    perm, step * batch_size, batch_size
+                )
+                bx = jnp.take(xs, idx, axis=0)
+                by = jnp.take(ys, idx, axis=0)
+                bm = jnp.take(mask, idx, axis=0)
+                (loss, acc), grads = grad_fn(params, bx, by, bm)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, acc)
+
+            (params, opt_state), (losses, accs) = jax.lax.scan(
+                step_body, (params, opt_state), jnp.arange(steps_per_epoch)
+            )
+            return (params, opt_state), (jnp.mean(losses), jnp.mean(accs))
+
+        epoch_keys = jax.random.split(key, local_epochs)
+        (params, opt_state), (epoch_losses, epoch_accs) = jax.lax.scan(
+            epoch_body, (params, opt_state), epoch_keys
+        )
+        metrics = {"loss": epoch_losses[-1], "accuracy": epoch_accs[-1]}
+        return params, opt_state, metrics
+
+    return local_train
+
+
+def pad_eval_set(x, y, batch_size: int):
+    """Host-side: pad + reshape a test set to ``[n_batches, batch_size, ...]``
+    with a mask, so evaluation is a fixed-shape ``lax.scan``."""
+    n = x.shape[0]
+    n_batches = (n + batch_size - 1) // batch_size
+    padded = n_batches * batch_size
+    xp = np.zeros((padded,) + x.shape[1:], dtype=x.dtype)
+    yp = np.zeros((padded,), dtype=np.int32)
+    mp = np.zeros((padded,), dtype=np.float32)
+    xp[:n], yp[:n], mp[:n] = x, y, 1.0
+    return (
+        xp.reshape((n_batches, batch_size) + x.shape[1:]),
+        yp.reshape((n_batches, batch_size)),
+        mp.reshape((n_batches, batch_size)),
+    )
+
+
+def make_eval_fn(apply_fn):
+    """Build ``evaluate(params, xb, yb, mb) -> {"loss", "accuracy"}``.
+
+    Full-test-set inference as a scan over pre-padded batches; parity with the
+    reference's per-round server-side evaluation (``get_metric`` ->
+    ``tester.inference()``, fed_server.py:26-32,85-86). vmap-able over a
+    params batch for Shapley subset evaluation.
+    """
+    def evaluate(params, xb, yb, mb):
+        def body(carry, batch):
+            x, y, m = batch
+            logits = apply_fn({"params": params}, x)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            correct = (jnp.argmax(logits, axis=1) == y).astype(jnp.float32)
+            loss_sum, correct_sum, count = carry
+            return (
+                loss_sum + jnp.sum(nll * m),
+                correct_sum + jnp.sum(correct * m),
+                count + jnp.sum(m),
+            ), None
+
+        (loss_sum, correct_sum, count), _ = jax.lax.scan(
+            body, (0.0, 0.0, 0.0), (xb, yb, mb)
+        )
+        count = jnp.maximum(count, 1.0)
+        return {"loss": loss_sum / count, "accuracy": correct_sum / count}
+
+    return evaluate

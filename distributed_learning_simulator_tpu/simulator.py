@@ -1,0 +1,268 @@
+"""Simulation orchestrator: the TPU-native ``simulator.py``.
+
+Replaces the reference entry point (reference simulator.py:33-72): where the
+reference builds a thread pool, a queue-owning server, and one worker thread
+per client, this builds
+
+  dataset -> client partition (packed client axis) -> model/optimizer ->
+  algorithm strategy -> ONE jitted round function -> host round loop.
+
+The host loop only sequences rounds, evaluates the global model once per
+round (parity with fed_server.py:85-86), logs, checkpoints, and runs the
+algorithm's host-side post_round hook (Shapley). All training compute for all
+clients in a round is a single XLA program launch.
+
+Multi-chip: set ``config.mesh_devices`` — the packed client arrays and
+per-client state get ``PartitionSpec("clients")`` over a 1-D mesh and the
+same program runs SPMD; weighted-mean/vote reductions become ICI collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.algorithms.base import RoundContext
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.data.partition import (
+    ClientData,
+    dirichlet_partition,
+    iid_partition,
+    pack_client_shards,
+)
+from distributed_learning_simulator_tpu.data.registry import Dataset, get_dataset
+from distributed_learning_simulator_tpu.factory import get_algorithm
+from distributed_learning_simulator_tpu.models.registry import get_model, init_params
+from distributed_learning_simulator_tpu.parallel.engine import (
+    make_eval_fn,
+    make_optimizer,
+    pad_eval_set,
+)
+from distributed_learning_simulator_tpu.parallel.mesh import (
+    make_mesh,
+    replicate,
+    shard_client_data,
+)
+from distributed_learning_simulator_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from distributed_learning_simulator_tpu.utils.logging import (
+    get_logger,
+    set_file_handler,
+    set_level,
+)
+
+
+def build_client_data(config: ExperimentConfig, dataset: Dataset) -> ClientData:
+    """Partition the training set into the packed client axis."""
+    if config.partition == "iid":
+        indices = iid_partition(
+            len(dataset.x_train), config.worker_number, seed=config.seed
+        )
+    else:
+        indices = dirichlet_partition(
+            dataset.y_train, config.worker_number, config.dirichlet_alpha,
+            seed=config.seed,
+        )
+    return pack_client_shards(
+        dataset.x_train, dataset.y_train, indices, batch_size=config.batch_size
+    )
+
+
+def run_simulation(
+    config: ExperimentConfig,
+    dataset: Dataset | None = None,
+    client_data: ClientData | None = None,
+    setup_logging: bool = True,
+):
+    """Run the full federated simulation; returns a result dict.
+
+    ``dataset``/``client_data`` injection points cover the reference's
+    heterogeneous-data variant (simulator_backup.py:71-77): build
+    ``client_data`` yourself, call ``client_data.override_client(0, ...)``,
+    and pass it in.
+    """
+    config.validate()
+    logger = get_logger()
+    set_level(config.log_level)
+    log_dir = None
+    if setup_logging:
+        log_path = set_file_handler(
+            config.log_root, config.distributed_algorithm,
+            config.dataset_name, config.model_name,
+        )
+        # Per-run artifact dir: Shapley metric pickles etc. go here so
+        # concurrent/subsequent runs never overwrite each other's artifacts.
+        log_dir = log_path[: -len(".log")] + "_artifacts"
+        logger.info("log file: %s", log_path)
+
+    # --- data ---------------------------------------------------------------
+    if dataset is None:
+        dataset = get_dataset(
+            config.dataset_name, data_dir=config.data_dir, seed=config.seed,
+            n_train=config.n_train, n_test=config.n_test,
+            **config.dataset_args,
+        )
+    if client_data is None:
+        client_data = build_client_data(config, dataset)
+    n_clients = client_data.n_clients
+    eval_batches_np = pad_eval_set(
+        dataset.x_test, dataset.y_test, config.eval_batch_size
+    )
+
+    # --- model / optimizer / algorithm --------------------------------------
+    model = get_model(config.model_name, num_classes=dataset.num_classes)
+    global_params = init_params(model, dataset.x_train[:1], seed=config.seed)
+    optimizer = make_optimizer(
+        config.optimizer_name, config.learning_rate,
+        momentum=config.momentum, weight_decay=config.weight_decay,
+    )
+    algorithm = get_algorithm(config.distributed_algorithm, config)
+
+    evaluate = jax.jit(make_eval_fn(model.apply))
+    algorithm.prepare(model.apply, make_eval_fn(model.apply))
+    round_fn = algorithm.make_round_fn(model.apply, optimizer, n_clients)
+    round_jit = jax.jit(round_fn, donate_argnums=(1,))
+
+    # --- resume (before placement, so restored state gets sharded too) ------
+    start_round = 0
+    prev_metrics: dict | None = None
+    key = jax.random.key(config.seed + 1)
+    client_state = algorithm.init_client_state(
+        optimizer, global_params, n_clients
+    )
+    if config.resume and config.checkpoint_dir:
+        ckpt_path = latest_checkpoint(config.checkpoint_dir)
+        if ckpt_path:
+            ckpt = load_checkpoint(ckpt_path)
+            global_params = jax.tree_util.tree_map(
+                jnp.asarray, ckpt["global_params"]
+            )
+            client_state = jax.tree_util.tree_map(
+                jnp.asarray, ckpt["client_state"]
+            )
+            start_round = ckpt["round_idx"] + 1
+            prev_metrics = ckpt["algo_state"].get("prev_metrics")
+            if ckpt.get("rng_key") is not None:
+                key = ckpt["rng_key"]
+            if hasattr(algorithm, "shapley_values"):
+                algorithm.shapley_values.update(
+                    ckpt["algo_state"].get("shapley_values", {})
+                )
+            logger.info("resumed from %s at round %d", ckpt_path, start_round)
+
+    # --- placement ----------------------------------------------------------
+    mesh = None
+    data_arrays = (
+        jnp.asarray(client_data.x), jnp.asarray(client_data.y),
+        jnp.asarray(client_data.mask),
+    )
+    sizes = jnp.asarray(client_data.sizes)
+    eval_batches = tuple(jnp.asarray(a) for a in eval_batches_np)
+    if config.mesh_devices and config.mesh_devices > 1:
+        mesh = make_mesh(config.mesh_devices)
+        if n_clients % config.mesh_devices != 0:
+            raise ValueError(
+                f"worker_number ({n_clients}) must be a multiple of "
+                f"mesh_devices ({config.mesh_devices})"
+            )
+        data_arrays = shard_client_data(data_arrays, mesh)
+        client_state = shard_client_data(client_state, mesh)
+        global_params = replicate(global_params, mesh)
+        sizes = replicate(sizes, mesh)
+        eval_batches = replicate(eval_batches, mesh)
+        logger.info("client axis sharded over %d devices", config.mesh_devices)
+    cx, cy, cmask = data_arrays
+
+    # --- round loop ---------------------------------------------------------
+    history: list[dict] = []
+    t_start = time.perf_counter()
+    for round_idx in range(start_round, config.round):
+        key, round_key = jax.random.split(key)
+        t0 = time.perf_counter()
+        new_global, client_state, aux = round_jit(
+            global_params, client_state, cx, cy, cmask, sizes, round_key
+        )
+        metrics_dev = evaluate(new_global, *eval_batches)
+        metrics = {k: float(v) for k, v in metrics_dev.items()}
+        round_time = time.perf_counter() - t0
+
+        ctx = RoundContext(
+            round_idx=round_idx,
+            global_params=new_global,
+            prev_global_params=global_params,
+            sizes=sizes,
+            aux=aux,
+            metrics=metrics,
+            prev_metrics=prev_metrics,
+            eval_batches=eval_batches,
+            log_dir=log_dir,
+        )
+        extra = algorithm.post_round(ctx) or {}
+        record = {
+            "round": round_idx,
+            "test_accuracy": metrics["accuracy"],
+            "test_loss": metrics["loss"],
+            "mean_client_loss": float(aux.get("mean_client_loss", np.nan)),
+            "round_seconds": round_time,
+            **{
+                k: v for k, v in extra.items()
+                if isinstance(v, (int, float, dict))
+            },
+        }
+        history.append(record)
+        logger.info(
+            "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
+            round_idx, metrics["accuracy"], metrics["loss"], round_time,
+        )
+        global_params = new_global
+        prev_metrics = metrics
+
+        if (
+            config.checkpoint_dir
+            and config.checkpoint_every
+            and (round_idx + 1) % config.checkpoint_every == 0
+        ):
+            algo_state = {"prev_metrics": metrics}
+            if hasattr(algorithm, "shapley_values"):
+                algo_state["shapley_values"] = algorithm.shapley_values
+            save_checkpoint(
+                os.path.join(config.checkpoint_dir, f"round_{round_idx}.ckpt"),
+                round_idx, global_params, client_state, algo_state, key,
+            )
+
+    total = time.perf_counter() - t_start
+    n_rounds = config.round - start_round
+    logger.info(
+        "finished %d rounds x %d clients in %.2fs (%.1f client-rounds/sec)",
+        n_rounds, n_clients, total,
+        n_rounds * n_clients / max(total, 1e-9),
+    )
+    return {
+        "global_params": global_params,
+        "client_state": client_state,
+        "history": history,
+        "algorithm": algorithm,
+        "final_accuracy": history[-1]["test_accuracy"] if history else None,
+        "total_seconds": total,
+        "client_rounds_per_sec": n_rounds * n_clients / max(total, 1e-9),
+        "mesh": mesh,
+    }
+
+
+def main(argv: list[str] | None = None):
+    from distributed_learning_simulator_tpu.config import get_config
+
+    config = get_config(argv)
+    result = run_simulation(config)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+from distributed_learning_simulator_tpu.utils.tree import (
+    tree_ravel,
+    tree_unravel,
+    tree_num_params,
+    tree_bytes,
+    tree_stack,
+    tree_unstack,
+    tree_index,
+)
+from distributed_learning_simulator_tpu.utils.logging import get_logger, set_file_handler
+
+__all__ = [
+    "tree_ravel",
+    "tree_unravel",
+    "tree_num_params",
+    "tree_bytes",
+    "tree_stack",
+    "tree_unstack",
+    "tree_index",
+    "get_logger",
+    "set_file_handler",
+]

@@ -1,0 +1,57 @@
+"""Logging: global logger + per-run file sink.
+
+Parity with the reference's ``get_logger`` / ``set_file_handler`` surface
+(reference simulator.py:7,38-46): one framework-global logger, with an optional
+file sink at ``log/<algorithm>/<dataset>/<model>/<timestamp>.log``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_LOGGER_NAME = "dls_tpu"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def set_file_handler(
+    log_root: str,
+    algorithm: str,
+    dataset: str,
+    model: str,
+    timestamp: float | None = None,
+) -> str:
+    """Attach a per-run file sink; returns the log file path.
+
+    Layout parity with reference simulator.py:38-46:
+    ``<log_root>/<algorithm>/<dataset>/<model>/<timestamp>.log``.
+    """
+    ts = timestamp if timestamp is not None else time.time()
+    log_dir = os.path.join(log_root, algorithm, dataset, model)
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"{int(ts)}.log")
+    handler = logging.FileHandler(path)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    get_logger().addHandler(handler)
+    return path
+
+
+def set_level(level: str) -> None:
+    """Parity with the reference's ``--log_level`` CLI flag (simulator.sh:1)."""
+    get_logger().setLevel(getattr(logging, level.upper()))

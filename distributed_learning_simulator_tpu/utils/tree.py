@@ -1,0 +1,78 @@
+"""Pytree utilities: the parameter boundary of the framework.
+
+TPU-native replacement for the reference's parameter-dict boundary
+(``ModelUtil.get_parameter_dict`` / ``load_parameter_dict``, reference
+servers/fed_server.py:6 and workers/fed_worker.py:30,38) and its payload
+flatten/size helpers (``concat_dict_values`` / ``load_dict_values`` /
+``get_data_serialization_size``, reference servers/fed_quant_server.py:4-6).
+In JAX, model parameters already *are* pytrees, so the dict<->tensor boundary
+collapses to ravel/unravel, and "serialization size" becomes analytic
+dtype-width x numel accounting (see ops/payload.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def tree_ravel(tree):
+    """Flatten a pytree into a single 1-D vector.
+
+    Returns ``(vector, unravel_fn)``; parity with the reference's
+    ``concat_dict_values`` (fed_quant_server.py:4,36) but differentiable and
+    jit-compatible.
+    """
+    return ravel_pytree(tree)
+
+
+def tree_unravel(unravel_fn, vector):
+    """Inverse of :func:`tree_ravel` (reference ``load_dict_values``)."""
+    return unravel_fn(vector)
+
+
+def tree_num_params(tree) -> int:
+    """Total number of scalar parameters in the pytree."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_bytes(tree, bits_per_element: int | None = None) -> int:
+    """Analytic payload size in bytes.
+
+    With ``bits_per_element=None``, uses each leaf's actual dtype width; with
+    an override (e.g. 8 for int8 uploads, 1 for sign-SGD), models the size of
+    a compressed payload. Replaces the reference's pickle-based
+    ``get_data_serialization_size`` (fed_quant_server.py:6,41-48): on TPU
+    nothing is serialized, so size is defined analytically.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if bits_per_element is None:
+        return sum(x.size * x.dtype.itemsize for x in leaves)
+    total_bits = sum(x.size for x in leaves) * bits_per_element
+    return (total_bits + 7) // 8
+
+
+def tree_stack(trees):
+    """Stack a list of identically-structured pytrees along a new leading axis.
+
+    This creates the *client axis*: where the reference holds one param dict
+    per worker thread (workers/fed_worker.py:30), we hold one pytree whose
+    every leaf has leading dim = num_clients.
+    """
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_unstack(tree):
+    """Split a client-stacked pytree back into a list of per-client pytrees."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    n = leaves[0].shape[0]
+    return [
+        jax.tree_util.tree_unflatten(treedef, [leaf[i] for leaf in leaves])
+        for i in range(n)
+    ]
+
+
+def tree_index(tree, i):
+    """Select client ``i``'s slice from a client-stacked pytree."""
+    return jax.tree_util.tree_map(lambda x: x[i], tree)

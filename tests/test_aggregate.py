@@ -1,0 +1,70 @@
+"""Aggregation math vs closed form (SURVEY §4 test strategy)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.ops.aggregate import (
+    subset_masks_all,
+    subset_weighted_mean,
+    weighted_mean,
+)
+
+
+def _stacked_tree(rng, n_clients=4):
+    return {
+        "w": jnp.asarray(rng.normal(size=(n_clients, 3, 2)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(n_clients, 5)).astype(np.float32)),
+    }
+
+
+def test_weighted_mean_closed_form(rng):
+    tree = _stacked_tree(rng)
+    weights = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    out = weighted_mean(tree, weights)
+    w = np.asarray(weights) / 10.0
+    for k in tree:
+        expect = np.tensordot(w, np.asarray(tree[k]), axes=(0, 0))
+        np.testing.assert_allclose(np.asarray(out[k]), expect, rtol=1e-5)
+
+
+def test_weighted_mean_equal_weights_is_mean(rng):
+    tree = _stacked_tree(rng)
+    out = weighted_mean(tree, jnp.ones(4))
+    for k in tree:
+        np.testing.assert_allclose(
+            np.asarray(out[k]), np.asarray(tree[k]).mean(axis=0), rtol=1e-5
+        )
+
+
+def test_subset_weighted_mean_matches_manual(rng):
+    tree = _stacked_tree(rng)
+    fallback = {k: jnp.zeros_like(v[0]) for k, v in tree.items()}
+    weights = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+    mask = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    out = subset_weighted_mean(tree, weights, mask, fallback)
+    for k in tree:
+        arr = np.asarray(tree[k])
+        expect = (10 * arr[0] + 30 * arr[2]) / 40.0
+        np.testing.assert_allclose(np.asarray(out[k]), expect, rtol=1e-5)
+
+
+def test_subset_weighted_mean_empty_falls_back(rng):
+    """Empty subset -> previous global model (reference fed_server.py:45-47)."""
+    tree = _stacked_tree(rng)
+    fallback = {
+        "w": jnp.full((3, 2), 7.0),
+        "b": jnp.full((5,), -1.0),
+    }
+    out = subset_weighted_mean(tree, jnp.ones(4), jnp.zeros(4), fallback)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(fallback[k]))
+
+
+def test_subset_masks_all_counts():
+    masks = subset_masks_all(4)
+    assert masks.shape == (16, 4)
+    assert (masks.sum(axis=1) == 0).sum() == 1  # one empty subset
+    # every subset unique
+    assert len({tuple(row) for row in masks.astype(int)}) == 16
+    no_empty = subset_masks_all(4, include_empty=False)
+    assert no_empty.shape == (15, 4)

@@ -1,0 +1,82 @@
+"""Quantization round-trip error bounds + unbiasedness (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.ops.payload import (
+    compression_ratio,
+    payload_bytes,
+    quantized_payload_bytes,
+)
+from distributed_learning_simulator_tpu.ops.quantize import (
+    dequantize,
+    dequantize_tree,
+    fake_quant,
+    stochastic_quantize,
+    stochastic_quantize_tree,
+)
+
+
+def test_roundtrip_error_bound(rng):
+    """|x - dq(q(x))| <= scale (one quantization step) elementwise."""
+    x = jnp.asarray(rng.normal(size=(128,)).astype(np.float32)) * 5.0
+    q = stochastic_quantize(x, levels=256, key=jax.random.key(0))
+    err = np.abs(np.asarray(dequantize(q)) - np.asarray(x))
+    assert err.max() <= float(q.scale) + 1e-6
+
+
+def test_codes_in_range(rng):
+    x = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+    q = stochastic_quantize(x, levels=16, key=jax.random.key(1))
+    codes = np.asarray(q.codes)
+    assert codes.min() >= 0 and codes.max() <= 15
+    np.testing.assert_allclose(codes, np.round(codes))  # integer-valued
+
+
+def test_stochastic_rounding_unbiased():
+    """E[dequantize(quantize(x))] == x across keys."""
+    x = jnp.asarray([0.1, 0.25, 0.5, 0.77, 0.9], dtype=jnp.float32)
+    keys = jax.random.split(jax.random.key(2), 2000)
+    dqs = jax.vmap(lambda k: dequantize(stochastic_quantize(x, 5, k)))(keys)
+    np.testing.assert_allclose(np.asarray(dqs).mean(axis=0), np.asarray(x),
+                               atol=0.01)
+
+
+def test_constant_tensor_safe():
+    x = jnp.full((8,), 3.14)
+    q = stochastic_quantize(x, 256, jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(dequantize(q)), 3.14, rtol=1e-5)
+
+
+def test_tree_roundtrip(rng):
+    tree = {
+        "a": jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(7,)).astype(np.float32)),
+    }
+    q = stochastic_quantize_tree(tree, 256, jax.random.key(3))
+    dq = dequantize_tree(q)
+    for k in tree:
+        assert np.abs(np.asarray(dq[k]) - np.asarray(tree[k])).max() < 0.1
+
+
+def test_fake_quant_straight_through_gradient():
+    """STE: d/dx sum(fake_quant(x)) == 1 everywhere."""
+    x = jnp.linspace(-2.0, 2.0, 31)
+    g = jax.grad(lambda v: jnp.sum(fake_quant(v, 16)))(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_fake_quant_forward_quantizes():
+    x = jnp.linspace(0.0, 1.0, 100)
+    y = fake_quant(x, 4)
+    assert len(np.unique(np.asarray(y).round(6))) <= 4
+
+
+def test_payload_accounting():
+    tree = {"w": jnp.zeros((100, 10), jnp.float32)}
+    raw = payload_bytes(tree)
+    assert raw == 1000 * 4
+    q8 = quantized_payload_bytes(tree, 256)
+    assert q8 == 1000 + 8  # 1 byte/elem + scale/zp metadata
+    assert 3.9 < compression_ratio(raw, q8) < 4.0

@@ -1,0 +1,72 @@
+"""Exact Shapley on toy games with known values (SURVEY §4 test strategy)."""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.algorithms.shapley import (
+    shapley_from_utilities,
+)
+
+
+def _all_subsets(n):
+    ids = list(range(n))
+    for size in range(n + 1):
+        for combo in combinations(ids, size):
+            yield frozenset(combo)
+
+
+def test_additive_game():
+    """u(S) = sum of member values -> SV_i = value_i exactly."""
+    values = np.array([1.0, 2.0, 3.0, 4.0])
+    utilities = {s: float(sum(values[i] for i in s)) for s in _all_subsets(4)}
+    sv = shapley_from_utilities(utilities, 4)
+    np.testing.assert_allclose(sv, values, rtol=1e-9)
+
+
+def test_glove_game():
+    """Classic 3-player glove game: players {0,1} hold left gloves, {2} right;
+    u(S)=1 iff S contains a left and the right. Known SVs: (1/6, 1/6, 2/3)."""
+    def u(s):
+        return 1.0 if (2 in s and (0 in s or 1 in s)) else 0.0
+
+    utilities = {s: u(s) for s in _all_subsets(3)}
+    sv = shapley_from_utilities(utilities, 3)
+    np.testing.assert_allclose(sv, [1 / 6, 1 / 6, 2 / 3], rtol=1e-9)
+
+
+def test_efficiency_property():
+    """sum(SV) == u(grand coalition) - u(empty) for any game."""
+    rng = np.random.default_rng(0)
+    utilities = {s: float(rng.normal()) for s in _all_subsets(5)}
+    sv = shapley_from_utilities(utilities, 5)
+    np.testing.assert_allclose(
+        sv.sum(),
+        utilities[frozenset(range(5))] - utilities[frozenset()],
+        rtol=1e-9,
+    )
+
+
+def test_symmetry_property():
+    """Symmetric players get identical SVs."""
+    utilities = {s: float(len(s) ** 2) for s in _all_subsets(4)}
+    sv = shapley_from_utilities(utilities, 4)
+    np.testing.assert_allclose(sv, sv[0])
+
+
+def test_exact_refuses_large_n(tiny_config):
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        MultiRoundShapley,
+    )
+    from distributed_learning_simulator_tpu.algorithms.base import RoundContext
+
+    tiny_config.worker_number = 17
+    algo = MultiRoundShapley(tiny_config)
+    ctx = RoundContext(
+        round_idx=0, global_params=None, prev_global_params=None,
+        sizes=np.ones(17), aux={}, metrics={"accuracy": 0.5},
+        prev_metrics=None, eval_batches=(), log_dir=None,
+    )
+    with pytest.raises(ValueError, match="2\\^N"):
+        algo.post_round(ctx)

@@ -1,0 +1,31 @@
+"""Sign-vote on crafted gradients (SURVEY §4 test strategy)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.ops.sign import majority_vote, sign_compress
+
+
+def test_sign_compress_matches_torch_sign_convention():
+    tree = {"w": jnp.asarray([-2.0, 0.0, 3.0])}
+    out = sign_compress(tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]), [-1.0, 0.0, 1.0])
+
+
+def test_majority_vote_crafted():
+    # 3 clients, elementwise: [+,+,-] -> +, [-,-,+] -> -, [+,-,0] -> 0
+    signs = jnp.asarray(
+        [
+            [1.0, -1.0, 1.0],
+            [1.0, -1.0, -1.0],
+            [-1.0, 1.0, 0.0],
+        ]
+    )
+    out = majority_vote({"g": signs})
+    np.testing.assert_array_equal(np.asarray(out["g"]), [1.0, -1.0, 0.0])
+
+
+def test_majority_vote_tie_is_zero():
+    signs = jnp.asarray([[1.0], [-1.0]])
+    out = majority_vote({"g": signs})
+    np.testing.assert_array_equal(np.asarray(out["g"]), [0.0])
