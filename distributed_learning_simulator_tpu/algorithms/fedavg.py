@@ -48,13 +48,29 @@ class FedAvg(Algorithm):
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
         keep = self.keep_client_params
+        chunk = cfg.client_chunk_size
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
             train_key, payload_key, agg_key = jax.random.split(key, 3)
             client_keys = jax.random.split(train_key, n_clients)
-            client_params, new_state, train_metrics = vtrain(
-                global_params, client_state, cx, cy, cmask, client_keys
-            )
+            if chunk is None or chunk >= n_clients:
+                client_params, new_state, train_metrics = vtrain(
+                    global_params, client_state, cx, cy, cmask, client_keys
+                )
+            else:
+                # Sequential-over-chunks, vmap-within-chunk (lax.map's
+                # batch_size does exactly this): bounds HBM use (per-client
+                # param/grad/momentum copies + activations) at chunk size
+                # while keeping the whole round one XLA program.
+                def one_client(args):
+                    state, x, y, m, k = args
+                    return local_train(global_params, state, x, y, m, k)
+
+                client_params, new_state, train_metrics = jax.lax.map(
+                    one_client,
+                    (client_state, cx, cy, cmask, client_keys),
+                    batch_size=chunk,
+                )
             client_params, payload_aux = self.process_client_payload(
                 client_params, payload_key
             )

@@ -59,6 +59,11 @@ class ExperimentConfig:
 
     # --- execution ----------------------------------------------------------
     mesh_devices: int | None = None  # None = single-device vmap path
+    # Max clients trained concurrently inside one round program. None = all
+    # at once (pure vmap). At large N the per-client params/grads/momentum
+    # copies and activations exceed HBM; chunking runs vmap-ed chunks
+    # sequentially (lax.map) with identical semantics.
+    client_chunk_size: int | None = None
     eval_batch_size: int = 512
     log_root: str = "log"
     checkpoint_dir: str | None = None

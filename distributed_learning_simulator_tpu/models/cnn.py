@@ -11,24 +11,38 @@ import jax.numpy as jnp
 
 
 class CifarCNN(nn.Module):
+    """Conv-pool x3 with a global-average-pool head.
+
+    Design notes for the 1000-client scale config: per-client parameter
+    copies are the HBM bottleneck when the client axis is vmap-ed (params,
+    grads, and momentum each materialize once per client), so the head is
+    GAP + a tiny dense (~100k params total) rather than a flatten+wide-dense.
+    Convs compute in bfloat16 (MXU-native); params stay float32 and logits
+    are returned float32 for a stable softmax. Pooling after every conv keeps
+    backprop-saved activations small.
+    """
+
     num_classes: int = 10
     width: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         w = self.width
-        x = nn.Conv(features=w, kernel_size=(3, 3), padding="SAME")(x)
-        x = nn.relu(x)
-        x = nn.Conv(features=w * 2, kernel_size=(3, 3), padding="SAME")(x)
-        x = nn.relu(x)
-        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
-        x = nn.Conv(features=w * 4, kernel_size=(3, 3), padding="SAME")(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(features=w, kernel_size=(3, 3), padding="SAME",
+                    dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
-        x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(features=w * 8)(x)
+        x = nn.Conv(features=w * 2, kernel_size=(3, 3), padding="SAME",
+                    dtype=self.dtype)(x)
         x = nn.relu(x)
-        x = nn.Dense(features=self.num_classes)(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.Conv(features=w * 4, kernel_size=(3, 3), padding="SAME",
+                    dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(features=self.num_classes, dtype=jnp.float32)(x)
         return x.astype(jnp.float32)
 
 

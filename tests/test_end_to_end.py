@@ -106,3 +106,12 @@ def test_heterogeneous_client_override(tiny_config, tiny_dataset):
     res = run_simulation(tiny_config, dataset=tiny_dataset, client_data=cd,
                          setup_logging=False)
     assert res["final_accuracy"] is not None
+
+
+def test_client_chunking_matches_unchunked(tiny_config):
+    """lax.map chunking is an execution detail: results must match pure vmap."""
+    base = _run(tiny_config, worker_number=8, round=2)
+    chunked = _run(tiny_config, worker_number=8, round=2, client_chunk_size=2)
+    a = [h["test_accuracy"] for h in base["history"]]
+    b = [h["test_accuracy"] for h in chunked["history"]]
+    np.testing.assert_allclose(b, a, atol=1e-5)
