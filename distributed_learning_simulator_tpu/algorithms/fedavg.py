@@ -49,32 +49,56 @@ class FedAvg(Algorithm):
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
         keep = self.keep_client_params
         chunk = cfg.client_chunk_size
+        frac = getattr(cfg, "participation_fraction", 1.0)
+        n_participants = (
+            n_clients if frac >= 1.0 else max(1, round(frac * n_clients))
+        )
+
+        def train_clients(global_params, state, x, y, m, keys):
+            if chunk is None or chunk >= keys.shape[0]:
+                return vtrain(global_params, state, x, y, m, keys)
+
+            # Sequential-over-chunks, vmap-within-chunk (lax.map's batch_size
+            # does exactly this): bounds HBM use (per-client param/grad/
+            # momentum copies + activations) at chunk size while keeping the
+            # whole round one XLA program.
+            def one_client(args):
+                s, xi, yi, mi, k = args
+                return local_train(global_params, s, xi, yi, mi, k)
+
+            return jax.lax.map(
+                one_client, (state, x, y, m, keys), batch_size=chunk
+            )
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
-            train_key, payload_key, agg_key = jax.random.split(key, 3)
-            client_keys = jax.random.split(train_key, n_clients)
-            if chunk is None or chunk >= n_clients:
-                client_params, new_state, train_metrics = vtrain(
+            part_key, train_key, payload_key, agg_key = jax.random.split(key, 4)
+            client_keys = jax.random.split(train_key, n_participants)
+            if n_participants == n_clients:
+                client_params, new_state, train_metrics = train_clients(
                     global_params, client_state, cx, cy, cmask, client_keys
                 )
+                part_sizes = sizes
             else:
-                # Sequential-over-chunks, vmap-within-chunk (lax.map's
-                # batch_size does exactly this): bounds HBM use (per-client
-                # param/grad/momentum copies + activations) at chunk size
-                # while keeping the whole round one XLA program.
-                def one_client(args):
-                    state, x, y, m, k = args
-                    return local_train(global_params, state, x, y, m, k)
-
-                client_params, new_state, train_metrics = jax.lax.map(
-                    one_client,
-                    (client_state, cx, cy, cmask, client_keys),
-                    batch_size=chunk,
+                # Client sampling: train only the sampled cohort (fixed size
+                # -> one compilation); non-participants keep their state and
+                # contribute nothing to aggregation.
+                idx = jax.random.choice(
+                    part_key, n_clients, (n_participants,), replace=False
                 )
+                take = lambda a: jnp.take(a, idx, axis=0)
+                state_k = jax.tree_util.tree_map(take, client_state)
+                client_params, new_state_k, train_metrics = train_clients(
+                    global_params, state_k, take(cx), take(cy), take(cmask),
+                    client_keys,
+                )
+                new_state = jax.tree_util.tree_map(
+                    lambda s, ns: s.at[idx].set(ns), client_state, new_state_k
+                )
+                part_sizes = jnp.take(sizes, idx, axis=0)
             client_params, payload_aux = self.process_client_payload(
                 client_params, payload_key
             )
-            new_global = weighted_mean(client_params, sizes)
+            new_global = weighted_mean(client_params, part_sizes)
             new_global, agg_aux = self.process_aggregated(new_global, agg_key)
             aux = {
                 "client_loss": train_metrics["loss"],
@@ -85,6 +109,8 @@ class FedAvg(Algorithm):
             }
             if keep:
                 aux["client_params"] = client_params
+                if n_participants != n_clients:
+                    aux["participants"] = idx
             return new_global, new_state, aux
 
         return round_fn

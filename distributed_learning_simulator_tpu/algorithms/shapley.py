@@ -113,6 +113,11 @@ class MultiRoundShapley(FedAvg):
 
     def __init__(self, config):
         super().__init__(config)
+        if getattr(config, "participation_fraction", 1.0) < 1.0:
+            raise ValueError(
+                "Shapley scoring needs every client's update each round; "
+                "participation_fraction < 1 is not supported"
+            )
         self.shapley_values: dict[int, dict[int, float]] = {}
         self._evaluator = None
 
@@ -184,6 +189,11 @@ class GTGShapley(FedAvg):
 
     def __init__(self, config):
         super().__init__(config)
+        if getattr(config, "participation_fraction", 1.0) < 1.0:
+            raise ValueError(
+                "Shapley scoring needs every client's update each round; "
+                "participation_fraction < 1 is not supported"
+            )
         self.shapley_values: dict[int, dict[int, float]] = {}
         self._evaluator = None
         self.eps = getattr(config, "gtg_eps", 1e-3)

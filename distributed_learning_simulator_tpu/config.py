@@ -64,6 +64,14 @@ class ExperimentConfig:
     # copies and activations exceed HBM; chunking runs vmap-ed chunks
     # sequentially (lax.map) with identical semantics.
     client_chunk_size: int | None = None
+    # Fraction of clients sampled (without replacement) to train+aggregate
+    # each round (FedAvg-family). 1.0 = all clients, the reference's fixed
+    # behavior; <1.0 is standard FL client sampling — and unlike the
+    # reference's barrier (fed_server.py:75-77, which hangs forever if a
+    # client goes missing), non-participants simply sit the round out.
+    participation_fraction: float = 1.0
+    # Write a jax.profiler trace of the whole run into this directory.
+    profile_dir: str | None = None
     eval_batch_size: int = 512
     log_root: str = "log"
     checkpoint_dir: str | None = None
@@ -77,6 +85,8 @@ class ExperimentConfig:
             raise ValueError("round must be >= 1")
         if self.partition not in ("iid", "dirichlet"):
             raise ValueError(f"unknown partition {self.partition!r}")
+        if not 0.0 < self.participation_fraction <= 1.0:
+            raise ValueError("participation_fraction must be in (0, 1]")
         return self
 
 
@@ -90,8 +100,12 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
                                 default=f.default)
         elif f.name in ("n_train", "n_test", "mesh_devices"):
             parser.add_argument(arg, type=int, default=None)
-        elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir"):
-            typ = float if f.name == "round_trunc_threshold" else str
+        elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
+                        "profile_dir", "client_chunk_size"):
+            typ = {
+                "round_trunc_threshold": float,
+                "client_chunk_size": int,
+            }.get(f.name, str)
             parser.add_argument(arg, type=typ, default=None)
         else:
             parser.add_argument(arg, type=type(f.default), default=f.default)

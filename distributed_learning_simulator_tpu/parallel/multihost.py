@@ -1,0 +1,44 @@
+"""Multi-host (DCN) initialization.
+
+The reference's dormant multi-process path (``TorchProcessTaskQueue``,
+reference servers/server.py:11-13, hard-disabled at simulator.py:56) is the
+closest it gets to multi-node. The TPU-native equivalent: initialize the JAX
+distributed runtime, after which ``jax.devices()`` spans every host's chips
+and the SAME mesh/sharding code (parallel/mesh.py) runs the client axis over
+ICI within a slice and DCN across slices — no separate code path.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Initialize jax.distributed; returns the global device count.
+
+    With no arguments, relies on the TPU environment's auto-configuration
+    (the standard path on Cloud TPU pods). Safe to call when already
+    initialized (returns immediately).
+    """
+    logger = get_logger()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Already initialized, or single-process environment.
+        logger.info("jax.distributed.initialize skipped: %s", e)
+    n = len(jax.devices())
+    logger.info(
+        "multihost: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), n,
+    )
+    return n

@@ -19,6 +19,7 @@ same program runs SPMD; weighted-mean/vote reductions become ICI collectives.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 
@@ -56,6 +57,10 @@ from distributed_learning_simulator_tpu.utils.logging import (
     get_logger,
     set_file_handler,
     set_level,
+)
+from distributed_learning_simulator_tpu.utils.tracing import (
+    annotate,
+    profile_session,
 )
 
 
@@ -182,60 +187,74 @@ def run_simulation(
 
     # --- round loop ---------------------------------------------------------
     history: list[dict] = []
+    metrics_path = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        metrics_path = os.path.join(log_dir, "metrics.jsonl")
     t_start = time.perf_counter()
-    for round_idx in range(start_round, config.round):
-        key, round_key = jax.random.split(key)
-        t0 = time.perf_counter()
-        new_global, client_state, aux = round_jit(
-            global_params, client_state, cx, cy, cmask, sizes, round_key
-        )
-        metrics_dev = evaluate(new_global, *eval_batches)
-        metrics = {k: float(v) for k, v in metrics_dev.items()}
-        round_time = time.perf_counter() - t0
+    with profile_session(config.profile_dir):
+        for round_idx in range(start_round, config.round):
+            key, round_key = jax.random.split(key)
+            t0 = time.perf_counter()
+            with annotate(f"fl_round_{round_idx}"):
+                new_global, client_state, aux = round_jit(
+                    global_params, client_state, cx, cy, cmask, sizes,
+                    round_key,
+                )
+            with annotate("server_eval"):
+                metrics_dev = evaluate(new_global, *eval_batches)
+            metrics = {k: float(v) for k, v in metrics_dev.items()}
+            round_time = time.perf_counter() - t0
 
-        ctx = RoundContext(
-            round_idx=round_idx,
-            global_params=new_global,
-            prev_global_params=global_params,
-            sizes=sizes,
-            aux=aux,
-            metrics=metrics,
-            prev_metrics=prev_metrics,
-            eval_batches=eval_batches,
-            log_dir=log_dir,
-        )
-        extra = algorithm.post_round(ctx) or {}
-        record = {
-            "round": round_idx,
-            "test_accuracy": metrics["accuracy"],
-            "test_loss": metrics["loss"],
-            "mean_client_loss": float(aux.get("mean_client_loss", np.nan)),
-            "round_seconds": round_time,
-            **{
-                k: v for k, v in extra.items()
-                if isinstance(v, (int, float, dict))
-            },
-        }
-        history.append(record)
-        logger.info(
-            "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
-            round_idx, metrics["accuracy"], metrics["loss"], round_time,
-        )
-        global_params = new_global
-        prev_metrics = metrics
-
-        if (
-            config.checkpoint_dir
-            and config.checkpoint_every
-            and (round_idx + 1) % config.checkpoint_every == 0
-        ):
-            algo_state = {"prev_metrics": metrics}
-            if hasattr(algorithm, "shapley_values"):
-                algo_state["shapley_values"] = algorithm.shapley_values
-            save_checkpoint(
-                os.path.join(config.checkpoint_dir, f"round_{round_idx}.ckpt"),
-                round_idx, global_params, client_state, algo_state, key,
+            ctx = RoundContext(
+                round_idx=round_idx,
+                global_params=new_global,
+                prev_global_params=global_params,
+                sizes=sizes,
+                aux=aux,
+                metrics=metrics,
+                prev_metrics=prev_metrics,
+                eval_batches=eval_batches,
+                log_dir=log_dir,
             )
+            with annotate("post_round"):
+                extra = algorithm.post_round(ctx) or {}
+            record = {
+                "round": round_idx,
+                "test_accuracy": metrics["accuracy"],
+                "test_loss": metrics["loss"],
+                "mean_client_loss": float(aux.get("mean_client_loss", np.nan)),
+                "round_seconds": round_time,
+                **{
+                    k: v for k, v in extra.items()
+                    if isinstance(v, (int, float, dict))
+                },
+            }
+            history.append(record)
+            if metrics_path:
+                with open(metrics_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            logger.info(
+                "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
+                round_idx, metrics["accuracy"], metrics["loss"], round_time,
+            )
+            global_params = new_global
+            prev_metrics = metrics
+
+            if (
+                config.checkpoint_dir
+                and config.checkpoint_every
+                and (round_idx + 1) % config.checkpoint_every == 0
+            ):
+                algo_state = {"prev_metrics": metrics}
+                if hasattr(algorithm, "shapley_values"):
+                    algo_state["shapley_values"] = algorithm.shapley_values
+                save_checkpoint(
+                    os.path.join(
+                        config.checkpoint_dir, f"round_{round_idx}.ckpt"
+                    ),
+                    round_idx, global_params, client_state, algo_state, key,
+                )
 
     total = time.perf_counter() - t_start
     n_rounds = config.round - start_round

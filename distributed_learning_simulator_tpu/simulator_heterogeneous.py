@@ -1,0 +1,88 @@
+"""Heterogeneous-data entry point: one client trains on a "bad" dataset.
+
+Parity with the reference's ``simulator_backup.py`` experiment
+(simulator_backup.py:50-53,71-77): worker 0's training shard is replaced with
+a different, grayscale-converted dataset (channel-tiled back to the packed
+array's channel count) while workers 1..N-1 keep IID shards of the configured
+dataset. Demonstrates the framework's per-client dataset override — the
+generic injection point is ``ClientData.override_client``.
+
+Usage (same CLI as the main simulator, plus --bad_dataset_name):
+
+    python -m distributed_learning_simulator_tpu.simulator_heterogeneous \
+        --dataset_name cifar10 --model_name cnn --distributed_algorithm fed \
+        --worker_number 4 --round 5 --epoch 1 --learning_rate 0.1
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.data.registry import get_dataset
+from distributed_learning_simulator_tpu.simulator import (
+    build_client_data,
+    run_simulation,
+)
+from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+
+def run_heterogeneous(
+    config: ExperimentConfig,
+    bad_dataset_name: str = "mnist",
+    bad_client_id: int = 0,
+):
+    """Run the simulation with ``bad_client_id``'s shard swapped out."""
+    dataset = get_dataset(
+        config.dataset_name, data_dir=config.data_dir, seed=config.seed,
+        n_train=config.n_train, n_test=config.n_test, **config.dataset_args,
+    )
+    client_data = build_client_data(config, dataset)
+
+    # The "bad" dataset: grayscale (dataset_args parity with
+    # simulator_backup.py:50 to_grayscale=True), resized by channel tiling to
+    # match the packed array's shape.
+    bad = get_dataset(
+        bad_dataset_name, data_dir=config.data_dir, seed=config.seed + 1,
+        n_train=client_data.shard_size, to_grayscale=True,
+    )
+    target_shape = client_data.x.shape[2:]  # (H, W, C)
+    bad_x = _fit_images(bad.x_train, target_shape)
+    get_logger().info(
+        "client %d gets %d samples of bad dataset %r (others keep %s shards)",
+        bad_client_id, len(bad_x), bad_dataset_name, config.dataset_name,
+    )
+    client_data.override_client(bad_client_id, bad_x, bad.y_train)
+    return run_simulation(config, dataset=dataset, client_data=client_data)
+
+
+def _fit_images(x: np.ndarray, shape) -> np.ndarray:
+    """Crop/pad spatially and tile channels so ``x`` fits ``shape``."""
+    h, w, c = shape
+    out = np.zeros((x.shape[0], h, w, c), dtype=np.float32)
+    hh, ww = min(h, x.shape[1]), min(w, x.shape[2])
+    src = x[:, :hh, :ww, :]
+    if src.shape[-1] == 1 and c > 1:
+        src = np.repeat(src, c, axis=-1)
+    out[:, :hh, :ww, : src.shape[-1]] = src[..., :c]
+    return out
+
+
+def main(argv: list[str] | None = None):
+    import argparse
+
+    from distributed_learning_simulator_tpu.config import get_config
+
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--bad_dataset_name", type=str, default="mnist")
+    pre.add_argument("--bad_client_id", type=int, default=0)
+    known, rest = pre.parse_known_args(argv)
+    config = get_config(rest)
+    return run_heterogeneous(
+        config, bad_dataset_name=known.bad_dataset_name,
+        bad_client_id=known.bad_client_id,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Tracing/profiling: jax.profiler integration.
+
+The reference has NO profiling instrumentation (SURVEY §5: the only
+performance-adjacent output is compression-ratio logging). This module
+exceeds parity: named trace annotations around the round / eval / post-round
+phases (visible in TensorBoard/Perfetto), plus an opt-in programmatic
+profiler session writing an XPlane trace directory.
+
+Usage: set ``config.profile_dir`` — the simulator wraps the run in
+``start_trace``/``stop_trace`` and annotates each phase.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def annotate(name: str):
+    """Named region visible in TPU traces (wraps jax.profiler annotations)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def profile_session(profile_dir: str | None):
+    """Profile the enclosed block into ``profile_dir`` (no-op if None)."""
+    if not profile_dir:
+        yield
+        return
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

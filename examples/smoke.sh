@@ -1,0 +1,14 @@
+#!/bin/bash
+# Canonical smoke run, parity with the reference launch script
+# (reference simulator.sh:1): MNIST + LeNet-5 + exact multi-round Shapley,
+# 4 workers, 2 local epochs, 10 rounds, lr 0.01.
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name mnist --model_name lenet5 \
+  --distributed_algorithm multiround_shapley_value \
+  --worker_number 4 --epoch 2 --round 10 --learning_rate 0.01 \
+  --log_level INFO
+# Commented variant, parity with reference simulator.sh:2:
+# python -m distributed_learning_simulator_tpu.simulator \
+#   --dataset_name mnist --model_name lenet5 \
+#   --distributed_algorithm sign_SGD \
+#   --worker_number 4 --epoch 2 --round 1 --learning_rate 0.01

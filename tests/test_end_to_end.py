@@ -115,3 +115,35 @@ def test_client_chunking_matches_unchunked(tiny_config):
     a = [h["test_accuracy"] for h in base["history"]]
     b = [h["test_accuracy"] for h in chunked["history"]]
     np.testing.assert_allclose(b, a, atol=1e-5)
+
+
+def test_participation_sampling(tiny_config):
+    """Client sampling: cohort of half the clients per round still learns,
+    and Shapley refuses partial participation."""
+    res = _run(tiny_config, worker_number=8, round=3,
+               participation_fraction=0.5)
+    assert res["final_accuracy"] > 0.15
+    with pytest.raises(ValueError, match="participation"):
+        _run(tiny_config, distributed_algorithm="multiround_shapley_value",
+             participation_fraction=0.5)
+
+
+def test_metrics_jsonl_written(tiny_config, tmp_path):
+    import dataclasses, json, glob, os
+    cfg = dataclasses.replace(tiny_config, log_root=str(tmp_path))
+    run_simulation(cfg)  # setup_logging defaults True -> writes artifacts
+    files = glob.glob(str(tmp_path / "**" / "metrics.jsonl"), recursive=True)
+    assert len(files) == 1
+    lines = [json.loads(l) for l in open(files[0])]
+    assert len(lines) == cfg.round
+    assert {"round", "test_accuracy", "round_seconds"} <= set(lines[0])
+
+
+def test_heterogeneous_entry_point(tiny_config, tmp_path):
+    import dataclasses
+    from distributed_learning_simulator_tpu.simulator_heterogeneous import (
+        run_heterogeneous,
+    )
+    cfg = dataclasses.replace(tiny_config, log_root=str(tmp_path), round=2)
+    res = run_heterogeneous(cfg, bad_dataset_name="synthetic")
+    assert res["final_accuracy"] is not None
