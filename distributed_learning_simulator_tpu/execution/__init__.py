@@ -1,0 +1,7 @@
+from distributed_learning_simulator_tpu.execution.threaded import (
+    ThreadedServer,
+    ThreadedWorker,
+    run_threaded_simulation,
+)
+
+__all__ = ["ThreadedServer", "ThreadedWorker", "run_threaded_simulation"]

@@ -1,0 +1,233 @@
+"""Threaded execution mode: architecture parity with the reference.
+
+The reference runs one OS thread per client, rendezvousing with a server
+through a blocking queue (reference simulator.py:60-69, servers/server.py:
+10-17, workers/fed_worker.py:19-38). The framework's fast path replaces all
+of that with one XLA program (parallel/engine.py) — but the thread/queue
+architecture remains useful as an *escape hatch*: per-client logic that
+cannot be vmapped (arbitrary Python callbacks, per-client model surgery,
+external I/O mid-round). This module provides that mode, backed by the
+native C++ runtime (runtime/native.py).
+
+Structure mirrors the reference exactly:
+
+  * :class:`ThreadedServer` owns the rendezvous queue constructed with
+    ``worker_fun=self._process_worker_data`` (servers/server.py:10-17) and
+    seeds it with the initial global params broadcast N times
+    (fed_server.py:16-24). The worker_fun buffers per-client uploads, and on
+    the Nth arrival aggregates (dataset-size-weighted mean,
+    fed_server.py:44-66,81), evaluates (fed_server.py:85-86), and broadcasts
+    (fed_server.py:88-91). Template hooks ``_process_client_parameter`` /
+    ``_process_aggregated_parameter`` are overridable (fed_server.py:38-42).
+  * :class:`ThreadedWorker` blocks for the global params, runs E local
+    epochs via the SAME jitted local_train the vmap path uses (one
+    compilation shared by every thread), and uploads
+    ``(worker_id, dataset_size, params)`` (fed_worker.py:19-38).
+
+Rounds are synchronized at round granularity, exactly like FedWorker.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.data.partition import ClientData
+from distributed_learning_simulator_tpu.data.registry import Dataset, get_dataset
+from distributed_learning_simulator_tpu.models.registry import get_model, init_params
+from distributed_learning_simulator_tpu.ops.aggregate import weighted_mean
+from distributed_learning_simulator_tpu.parallel.engine import (
+    make_eval_fn,
+    make_local_train_fn,
+    make_optimizer,
+    pad_eval_set,
+)
+from distributed_learning_simulator_tpu.runtime.native import (
+    NativeTaskQueue,
+    NativeThreadPool,
+    RepeatedResult,
+)
+from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+
+class ThreadedServer:
+    """Queue-owning server (reference servers/server.py + fed_server.py)."""
+
+    def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
+                 init_params_tree):
+        self.config = config
+        self.worker_number = config.worker_number
+        self._evaluate = evaluate
+        self._eval_batches = eval_batches
+        self._buffer: dict[int, tuple[float, dict]] = {}
+        self._round = 0
+        self.history: list[dict] = []
+        self.prev_model = init_params_tree
+        self._round_t0 = time.perf_counter()
+        self.worker_data_queue = NativeTaskQueue(
+            worker_fun=self._process_worker_data
+        )
+        # Seed the initial broadcast (fed_server.py:16-24).
+        self.worker_data_queue.put_result(
+            jax.device_get(init_params_tree), copies=self.worker_number
+        )
+
+    # Template hooks (fed_server.py:38-42).
+    def _process_client_parameter(self, worker_id: int, params):
+        return params
+
+    def _process_aggregated_parameter(self, params):
+        return params
+
+    def _process_worker_data(self, data, extra_args):
+        del extra_args
+        worker_id, dataset_size, params = data
+        self._buffer[worker_id] = (
+            dataset_size, self._process_client_parameter(worker_id, params)
+        )
+        if len(self._buffer) < self.worker_number:
+            return None  # barrier: wait for all clients (fed_server.py:75-77)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[self._buffer[i][1] for i in range(self.worker_number)],
+        )
+        sizes = jnp.asarray(
+            [self._buffer[i][0] for i in range(self.worker_number)],
+            dtype=jnp.float32,
+        )
+        aggregated = weighted_mean(stacked, sizes)
+        aggregated = self._process_aggregated_parameter(aggregated)
+        metrics = {
+            k: float(v)
+            for k, v in self._evaluate(aggregated, *self._eval_batches).items()
+        }
+        self.history.append({
+            "round": self._round,
+            "test_accuracy": metrics["accuracy"],
+            "test_loss": metrics["loss"],
+            "round_seconds": time.perf_counter() - self._round_t0,
+        })
+        get_logger().info(
+            "threaded round %d: test_acc=%.4f test_loss=%.4f",
+            self._round, metrics["accuracy"], metrics["loss"],
+        )
+        self.prev_model = aggregated
+        self._round += 1
+        self._round_t0 = time.perf_counter()
+        self._buffer.clear()
+        return RepeatedResult(jax.device_get(aggregated), self.worker_number)
+
+    def stop(self):
+        self.worker_data_queue.stop()
+
+
+class ThreadedWorker:
+    """One simulated client on its own thread (reference workers/fed_worker.py)."""
+
+    def __init__(self, worker_id: int, queue: NativeTaskQueue, local_train,
+                 shard, rounds: int, seed: int):
+        self.worker_id = worker_id
+        self.queue = queue
+        self._local_train = local_train
+        self._shard = shard  # (xs, ys, mask, size)
+        self._rounds = rounds
+        self._seed = seed
+
+    def train(self):
+        xs, ys, mask, size = self._shard
+        key = jax.random.key(self._seed * 100003 + self.worker_id)
+        for _ in range(self._rounds):
+            # Block for the current global model (fed_worker.py:22,37).
+            params = self.queue.get_result()
+            params = jax.tree_util.tree_map(jnp.asarray, params)
+            key, round_key = jax.random.split(key)
+            new_params, _, _ = self._local_train(
+                params, None, xs, ys, mask, round_key
+            )
+            # Upload (worker_id, |D_i|, params) (fed_worker.py:28-35).
+            self.queue.add_task(
+                (self.worker_id, size, jax.device_get(new_params))
+            )
+
+
+def run_threaded_simulation(
+    config: ExperimentConfig,
+    dataset: Dataset | None = None,
+    client_data: ClientData | None = None,
+):
+    """Run FedAvg in thread-per-client mode; returns a result dict.
+
+    Semantically equivalent to ``run_simulation`` with algorithm="fed" and
+    reset_client_optimizer=True (client batch order differs, so trajectories
+    match statistically, not bitwise).
+    """
+    from distributed_learning_simulator_tpu.simulator import build_client_data
+
+    config.validate()
+    if config.distributed_algorithm != "fed":
+        raise ValueError(
+            "threaded execution mode currently supports algorithm 'fed'"
+        )
+    if dataset is None:
+        dataset = get_dataset(
+            config.dataset_name, data_dir=config.data_dir, seed=config.seed,
+            n_train=config.n_train, n_test=config.n_test,
+            **config.dataset_args,
+        )
+    if client_data is None:
+        client_data = build_client_data(config, dataset)
+
+    model = get_model(config.model_name, num_classes=dataset.num_classes)
+    params = init_params(model, dataset.x_train[:1], seed=config.seed)
+    optimizer = make_optimizer(
+        config.optimizer_name, config.learning_rate,
+        momentum=config.momentum, weight_decay=config.weight_decay,
+    )
+    local_train = jax.jit(
+        make_local_train_fn(
+            model.apply, optimizer, local_epochs=config.epoch,
+            batch_size=config.batch_size, reset_optimizer=True,
+        )
+    )
+    evaluate = jax.jit(make_eval_fn(model.apply))
+    eval_batches = tuple(
+        jnp.asarray(a)
+        for a in pad_eval_set(
+            dataset.x_test, dataset.y_test, config.eval_batch_size
+        )
+    )
+
+    t_start = time.perf_counter()
+    server = ThreadedServer(config, evaluate, eval_batches, params)
+    pool = NativeThreadPool(config.worker_number)
+    try:
+        for worker_id in range(client_data.n_clients):
+            shard = (
+                jnp.asarray(client_data.x[worker_id]),
+                jnp.asarray(client_data.y[worker_id]),
+                jnp.asarray(client_data.mask[worker_id]),
+                float(client_data.sizes[worker_id]),
+            )
+            worker = ThreadedWorker(
+                worker_id, server.worker_data_queue, local_train, shard,
+                config.round, config.seed,
+            )
+            pool.exec(worker.train)
+        pool.join_pending()
+        pool.results()  # re-raise any worker error
+    finally:
+        pool.stop()
+        server.stop()
+    total = time.perf_counter() - t_start
+    history = server.history
+    n = client_data.n_clients
+    return {
+        "global_params": server.prev_model,
+        "history": history,
+        "final_accuracy": history[-1]["test_accuracy"] if history else None,
+        "total_seconds": total,
+        "client_rounds_per_sec": config.round * n / max(total, 1e-9),
+    }

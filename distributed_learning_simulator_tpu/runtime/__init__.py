@@ -1,0 +1,13 @@
+from distributed_learning_simulator_tpu.runtime.native import (
+    NativeTaskQueue,
+    NativeThreadPool,
+    RepeatedResult,
+    native_available,
+)
+
+__all__ = [
+    "NativeTaskQueue",
+    "NativeThreadPool",
+    "RepeatedResult",
+    "native_available",
+]

@@ -1,0 +1,272 @@
+"""ctypes bindings for the native (C++) runtime: queue + thread pool.
+
+Provides the reference's external L1 runtime surface natively
+(SURVEY §2.4; reference import sites: servers/server.py:1-3 ThreadTaskQueue /
+TorchProcessTaskQueue, simulator.py:5-6 ThreadPool, servers/fed_server.py:3
+RepeatedResult):
+
+  * :class:`NativeTaskQueue` — blocking rendezvous queue. Workers
+    ``add_task(obj)`` and block on ``get_result()``; the server side either
+    polls ``get_task()`` or registers ``worker_fun`` (a callback run on a
+    dedicated native thread for every task — the reference queue's
+    constructor contract, servers/server.py:10-17). A ``worker_fun`` return
+    of ``None`` means no reply; a :class:`RepeatedResult` broadcasts its
+    payload N times (reference fed_server.py:88-91).
+  * :class:`NativeThreadPool` — ``exec(fn, *args)`` / ``join_pending()`` /
+    ``stop()`` (reference simulator.py:60-71).
+
+Payloads cross the C boundary as pickle bytes. The shared library is built
+from ``native/dls_runtime.cc`` on first use if missing (g++, ~1s).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdls_runtime.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+_CALLBACK_T = ctypes.CFUNCTYPE(None, ctypes.c_uint64)
+
+
+def _build_library() -> None:
+    src = os.path.join(_NATIVE_DIR, "dls_runtime.cc")
+    if not os.path.exists(src):
+        raise FileNotFoundError(f"native source not found: {src}")
+    get_logger().info("building native runtime: %s", _LIB_PATH)
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+         "-o", _LIB_PATH, src, "-lpthread"],
+        check=True, capture_output=True,
+    )
+
+
+def _get_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_NATIVE_DIR, "dls_runtime.cc")
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        ):
+            _build_library()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.dlsq_create.restype = ctypes.c_void_p
+        lib.dlsq_destroy.argtypes = [ctypes.c_void_p]
+        lib.dlsq_add_task.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        lib.dlsq_get_task.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.dlsq_put_result.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int
+        ]
+        lib.dlsq_get_result.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.dlsq_stop.argtypes = [ctypes.c_void_p]
+        lib.dlsq_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        lib.dlsp_create.restype = ctypes.c_void_p
+        lib.dlsp_create.argtypes = [ctypes.c_int]
+        lib.dlsp_destroy.argtypes = [ctypes.c_void_p]
+        lib.dlsp_submit.argtypes = [ctypes.c_void_p, _CALLBACK_T, ctypes.c_uint64]
+        lib.dlsp_join_pending.argtypes = [ctypes.c_void_p]
+        lib.dlsp_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """True if the native library is present or buildable."""
+    try:
+        _get_lib()
+        return True
+    except Exception:  # noqa: BLE001 - availability probe
+        return False
+
+
+@dataclass
+class RepeatedResult:
+    """One-to-N broadcast wrapper (reference fed_server.py:3,19-24)."""
+
+    data: Any
+    num: int
+
+
+class NativeTaskQueue:
+    """Blocking rendezvous queue backed by the C++ runtime.
+
+    ``worker_fun``: if given, a dedicated native-backed thread consumes every
+    task and calls ``worker_fun(task, extra_args)``; a non-None return is
+    broadcast (``RepeatedResult``) or enqueued once (any other object) —
+    the reference queue contract (servers/server.py:11-17,
+    fed_server.py:68-91).
+    """
+
+    def __init__(self, worker_fun: Callable | None = None, extra_args=None):
+        self._lib = _get_lib()
+        self._q = self._lib.dlsq_create()
+        self._stopped = False
+        self._server_thread = None
+        if worker_fun is not None:
+            self._server_thread = threading.Thread(
+                target=self._serve, args=(worker_fun, extra_args), daemon=True
+            )
+            self._server_thread.start()
+
+    def _take(self, getter) -> Any | None:
+        out = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_size_t()
+        rc = getter(self._q, ctypes.byref(out), ctypes.byref(out_len))
+        if rc != 0:
+            return None  # stopped
+        try:
+            payload = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.dlsq_free(out)
+        return pickle.loads(payload)
+
+    def _serve(self, worker_fun, extra_args):
+        while True:
+            task = self._take(self._lib.dlsq_get_task)
+            if task is None:
+                return
+            result = worker_fun(task, extra_args)
+            if result is None:
+                continue
+            try:
+                if isinstance(result, RepeatedResult):
+                    self.put_result(result.data, copies=result.num)
+                else:
+                    self.put_result(result, copies=1)
+            except RuntimeError:
+                # stop() raced the final broadcast; nobody is listening.
+                return
+
+    # ---- worker side -------------------------------------------------------
+    def add_task(self, obj: Any) -> None:
+        payload = pickle.dumps(obj)
+        rc = self._lib.dlsq_add_task(self._q, payload, len(payload))
+        if rc != 0:
+            raise RuntimeError("queue is stopped")
+
+    def get_result(self) -> Any:
+        result = self._take(self._lib.dlsq_get_result)
+        if result is None:
+            raise RuntimeError("queue is stopped")
+        return result
+
+    # ---- server side -------------------------------------------------------
+    def get_task(self) -> Any | None:
+        """Blocking pop of one task; None once stopped."""
+        return self._take(self._lib.dlsq_get_task)
+
+    def put_result(self, obj: Any, copies: int = 1) -> None:
+        payload = pickle.dumps(obj)
+        rc = self._lib.dlsq_put_result(self._q, payload, len(payload), copies)
+        if rc != 0:
+            raise RuntimeError("queue is stopped")
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._lib.dlsq_stop(self._q)
+            if self._server_thread is not None:
+                self._server_thread.join(timeout=5)
+
+    def __del__(self):
+        try:
+            self.stop()
+            self._lib.dlsq_destroy(self._q)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
+
+
+class NativeThreadPool:
+    """Thread pool running Python callables on native threads.
+
+    Reference surface: ``ThreadPool.exec(fn, **kw)`` + ``stop()``
+    (simulator.py:60-71). Callbacks cross into Python via a ctypes
+    trampoline (which re-acquires the GIL); jitted jax computations release
+    the GIL during device execution, so per-client training overlaps.
+    """
+
+    def __init__(self, n_threads: int):
+        self._lib = _get_lib()
+        self._pool = self._lib.dlsp_create(n_threads)
+        self._tasks: dict[int, tuple] = {}
+        self._results: dict[int, Any] = {}
+        self._errors: dict[int, BaseException] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        # The trampoline must outlive every pending call: keep a reference.
+        self._trampoline = _CALLBACK_T(self._run_task)
+        self._stopped = False
+
+    def _run_task(self, task_id: int) -> None:
+        with self._lock:
+            fn, args, kwargs = self._tasks.pop(task_id)
+        try:
+            result = fn(*args, **kwargs)
+            with self._lock:
+                self._results[task_id] = result
+        except BaseException as e:  # noqa: BLE001 - surfaced via results()
+            with self._lock:
+                self._errors[task_id] = e
+
+    def exec(self, fn: Callable, *args, **kwargs) -> int:
+        """Submit ``fn(*args, **kwargs)``; returns a task id."""
+        with self._lock:
+            task_id = self._next_id
+            self._next_id += 1
+            self._tasks[task_id] = (fn, args, kwargs)
+        rc = self._lib.dlsp_submit(self._pool, self._trampoline, task_id)
+        if rc != 0:
+            with self._lock:
+                self._tasks.pop(task_id, None)
+            raise RuntimeError("pool is stopped")
+        return task_id
+
+    def join_pending(self) -> None:
+        """Block until every submitted task has run."""
+        self._lib.dlsp_join_pending(self._pool)
+
+    def results(self) -> dict[int, Any]:
+        """Completed results by task id; raises the first captured error."""
+        with self._lock:
+            if self._errors:
+                raise next(iter(self._errors.values()))
+            return dict(self._results)
+
+    def stop(self) -> None:
+        """Join all pending work and shut the pool down (reference
+        ThreadPool.stop, simulator.py:71)."""
+        if not self._stopped:
+            self.join_pending()
+            self._stopped = True
+            self._lib.dlsp_stop(self._pool)
+
+    def __del__(self):
+        try:
+            self.stop()
+            self._lib.dlsp_destroy(self._pool)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass

@@ -1,0 +1,213 @@
+// dls_runtime: native runtime for the TPU federated-learning simulator.
+//
+// TPU-native equivalent of the reference's external L1 runtime surface
+// (reference servers/server.py:1-3 imports ThreadTaskQueue /
+// TorchProcessTaskQueue; simulator.py:5-6 imports ThreadPool/ProcessPool;
+// servers/fed_server.py:3 imports RepeatedResult): a C++17 blocking
+// byte-payload rendezvous queue with one-to-N result broadcast, and a
+// thread pool that invokes Python callbacks from native threads.
+//
+// The fast path of the framework never touches this — synchronous FL is one
+// XLA program (see parallel/engine.py). This runtime backs the *threaded
+// execution mode* (execution/threaded.py): architecture parity with the
+// reference's thread-per-client design for workloads with per-client Python
+// logic that cannot be vmapped.
+//
+// C ABI only (consumed via ctypes); payloads are opaque byte buffers.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Buffer {
+  char* data;
+  size_t len;
+};
+
+Buffer copy_in(const char* data, size_t len) {
+  char* p = static_cast<char*>(::malloc(len ? len : 1));
+  if (len) std::memcpy(p, data, len);
+  return Buffer{p, len};
+}
+
+// A two-channel rendezvous queue:
+//   task channel:   workers -> server (add_task / get_task)
+//   result channel: server -> workers (put_result xN / get_result)
+// Mirrors the reference queue's contract: workers block on get_result,
+// the server broadcasts by enqueueing N copies (RepeatedResult semantics,
+// reference fed_server.py:19-24,88-91).
+class RendezvousQueue {
+ public:
+  ~RendezvousQueue() {
+    stop();
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& b : tasks_) ::free(b.data);
+    for (auto& b : results_) ::free(b.data);
+  }
+
+  int add_task(const char* data, size_t len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopped_) return -1;
+    tasks_.push_back(copy_in(data, len));
+    task_cv_.notify_one();
+    return 0;
+  }
+
+  int get_task(char** out, size_t* out_len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    task_cv_.wait(lk, [&] { return stopped_ || !tasks_.empty(); });
+    if (tasks_.empty()) return -1;  // stopped
+    Buffer b = tasks_.front();
+    tasks_.pop_front();
+    *out = b.data;
+    *out_len = b.len;
+    return 0;
+  }
+
+  int put_result(const char* data, size_t len, int copies) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopped_) return -1;
+    for (int i = 0; i < copies; ++i) results_.push_back(copy_in(data, len));
+    result_cv_.notify_all();
+    return 0;
+  }
+
+  int get_result(char** out, size_t* out_len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    result_cv_.wait(lk, [&] { return stopped_ || !results_.empty(); });
+    if (results_.empty()) return -1;  // stopped
+    Buffer b = results_.front();
+    results_.pop_front();
+    *out = b.data;
+    *out_len = b.len;
+    return 0;
+  }
+
+  void stop() {
+    std::lock_guard<std::mutex> lk(mu_);
+    stopped_ = true;
+    task_cv_.notify_all();
+    result_cv_.notify_all();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable task_cv_;
+  std::condition_variable result_cv_;
+  std::deque<Buffer> tasks_;
+  std::deque<Buffer> results_;
+  bool stopped_ = false;
+};
+
+// Thread pool executing opaque callbacks (Python functions via ctypes
+// CFUNCTYPE, which re-acquires the GIL per call). Reference surface:
+// ThreadPool.exec / .stop (simulator.py:60-71).
+using Callback = void (*)(uint64_t);
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n_threads) {
+    for (int i = 0; i < n_threads; ++i) {
+      threads_.emplace_back([this] { run(); });
+    }
+  }
+
+  ~ThreadPool() { stop(); }
+
+  int submit(Callback cb, uint64_t arg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopped_) return -1;
+    work_.push_back({cb, arg});
+    cv_.notify_one();
+    return 0;
+  }
+
+  // Blocks until every submitted task has finished.
+  void join_pending() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return work_.empty() && active_ == 0; });
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stopped_) return;
+      stopped_ = true;
+      cv_.notify_all();
+    }
+    for (auto& t : threads_) {
+      if (t.joinable()) t.join();
+    }
+  }
+
+ private:
+  void run() {
+    for (;;) {
+      std::pair<Callback, uint64_t> item;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stopped_ || !work_.empty(); });
+        if (work_.empty()) return;  // stopped
+        item = work_.front();
+        work_.pop_front();
+        ++active_;
+      }
+      item.first(item.second);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        --active_;
+        if (work_.empty() && active_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  std::deque<std::pair<Callback, uint64_t>> work_;
+  std::vector<std::thread> threads_;
+  int active_ = 0;
+  bool stopped_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- queue ----------------------------------------------------------------
+void* dlsq_create() { return new RendezvousQueue(); }
+void dlsq_destroy(void* q) { delete static_cast<RendezvousQueue*>(q); }
+int dlsq_add_task(void* q, const char* data, size_t len) {
+  return static_cast<RendezvousQueue*>(q)->add_task(data, len);
+}
+int dlsq_get_task(void* q, char** out, size_t* out_len) {
+  return static_cast<RendezvousQueue*>(q)->get_task(out, out_len);
+}
+int dlsq_put_result(void* q, const char* data, size_t len, int copies) {
+  return static_cast<RendezvousQueue*>(q)->put_result(data, len, copies);
+}
+int dlsq_get_result(void* q, char** out, size_t* out_len) {
+  return static_cast<RendezvousQueue*>(q)->get_result(out, out_len);
+}
+void dlsq_stop(void* q) { static_cast<RendezvousQueue*>(q)->stop(); }
+void dlsq_free(char* p) { ::free(p); }
+
+// ---- thread pool ----------------------------------------------------------
+void* dlsp_create(int n_threads) { return new ThreadPool(n_threads); }
+void dlsp_destroy(void* p) { delete static_cast<ThreadPool*>(p); }
+int dlsp_submit(void* p, Callback cb, uint64_t arg) {
+  return static_cast<ThreadPool*>(p)->submit(cb, arg);
+}
+void dlsp_join_pending(void* p) {
+  static_cast<ThreadPool*>(p)->join_pending();
+}
+void dlsp_stop(void* p) { static_cast<ThreadPool*>(p)->stop(); }
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+"""Native C++ runtime: queue semantics, pool execution, threaded mode.
+
+Covers the reference's L1 runtime surface contract (SURVEY §2.4 rows 1-3:
+ThreadPool, blocking TaskQueue with worker_fun, RepeatedResult broadcast)
+as real unit tests — the reference has none.
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from distributed_learning_simulator_tpu.runtime.native import (
+    NativeTaskQueue,
+    NativeThreadPool,
+    RepeatedResult,
+    native_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime not buildable"
+)
+
+
+def test_queue_task_roundtrip():
+    q = NativeTaskQueue()
+    q.add_task({"worker": 1, "payload": [1, 2, 3]})
+    assert q.get_task() == {"worker": 1, "payload": [1, 2, 3]}
+    q.stop()
+
+
+def test_queue_broadcast():
+    """RepeatedResult: one put_result(copies=N) feeds N get_result calls."""
+    q = NativeTaskQueue()
+    q.put_result("params", copies=3)
+    assert [q.get_result() for _ in range(3)] == ["params"] * 3
+    q.stop()
+
+
+def test_queue_blocking_get_result():
+    q = NativeTaskQueue()
+    got = []
+
+    def consumer():
+        got.append(q.get_result())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.1)
+    assert got == []  # still blocked
+    q.put_result(42)
+    t.join(timeout=5)
+    assert got == [42]
+    q.stop()
+
+
+def test_queue_stop_unblocks_and_raises():
+    q = NativeTaskQueue()
+    errors = []
+
+    def consumer():
+        try:
+            q.get_result()
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.stop()
+    t.join(timeout=5)
+    assert errors == ["queue is stopped"]
+    with pytest.raises(RuntimeError):
+        q.add_task(1)
+
+
+def test_queue_worker_fun_barrier():
+    """worker_fun contract: None until all N arrive, then broadcast
+    (reference servers/server.py:11-17 + fed_server.py:68-91)."""
+    n = 4
+
+    class Server:
+        def __init__(self):
+            self.buffer = []
+
+        def worker_fun(self, task, extra):
+            self.buffer.append(task)
+            if len(self.buffer) < n:
+                return None
+            total = sum(self.buffer)
+            self.buffer.clear()
+            return RepeatedResult(total, n)
+
+    server = Server()
+    q = NativeTaskQueue(worker_fun=server.worker_fun)
+    for i in range(n):
+        q.add_task(i + 1)
+    results = [q.get_result() for _ in range(n)]
+    assert results == [10] * n
+    q.stop()
+
+
+def test_pool_executes_all_tasks():
+    pool = NativeThreadPool(4)
+    seen = []
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            seen.append(i)
+        return i * i
+
+    ids = [pool.exec(work, i) for i in range(20)]
+    pool.join_pending()
+    results = pool.results()
+    assert sorted(seen) == list(range(20))
+    assert all(results[tid] == i * i for tid, i in zip(ids, range(20)))
+    pool.stop()
+
+
+def test_pool_propagates_errors():
+    pool = NativeThreadPool(2)
+
+    def boom():
+        raise ValueError("client exploded")
+
+    pool.exec(boom)
+    pool.join_pending()
+    with pytest.raises(ValueError, match="client exploded"):
+        pool.results()
+    pool.stop()
+
+
+def test_threaded_simulation_learns(tiny_config):
+    """Thread-per-client mode (native queue + pool) reaches the same
+    learning behavior as the vmap fast path."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, round=3)
+    res = run_threaded_simulation(cfg)
+    assert len(res["history"]) == 3
+    accs = [h["test_accuracy"] for h in res["history"]]
+    assert accs[-1] > 0.2
+    assert accs[-1] > accs[0] - 0.05
+
+
+def test_threaded_rejects_other_algorithms(tiny_config):
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, distributed_algorithm="sign_SGD")
+    with pytest.raises(ValueError, match="threaded"):
+        run_threaded_simulation(cfg)
