@@ -54,7 +54,8 @@ class Algorithm:
 
     # ---- jit side ----------------------------------------------------------
     def make_round_fn(
-        self, apply_fn: Callable, optimizer, n_clients: int
+        self, apply_fn: Callable, optimizer, n_clients: int,
+        preprocess: Callable | None = None,
     ) -> Callable:
         """Return ``round_fn(global_params, client_state, cx, cy, cmask,
         sizes, key) -> (new_global, new_client_state, aux)``.
@@ -66,7 +67,14 @@ class Algorithm:
         raise NotImplementedError
 
     def init_client_state(self, optimizer, global_params, n_clients):
-        """Initial per-client persistent state (client-stacked pytree)."""
+        """Initial per-client persistent state (client-stacked pytree).
+
+        None when client optimizers reset every round (the default): no
+        state persists, and carrying a per-client optimizer-state pytree at
+        1000-client scale would cost a model-size buffer per client.
+        """
+        if getattr(self.config, "reset_client_optimizer", True):
+            return None
         return jax.vmap(lambda _: optimizer.init(global_params))(
             jax.numpy.arange(n_clients)
         )

@@ -55,7 +55,8 @@ class SignSGD(Algorithm):
         )
         return {"momenta": momenta, "steps": jnp.zeros(n_clients, jnp.int32)}
 
-    def make_round_fn(self, apply_fn, optimizer, n_clients: int):
+    def make_round_fn(self, apply_fn, optimizer, n_clients: int,
+                      preprocess=None):
         cfg = self.config
         lr = cfg.learning_rate
         mu = cfg.momentum
@@ -87,6 +88,8 @@ class SignSGD(Algorithm):
                     bx = jax.vmap(lambda x, i: jnp.take(x, i, axis=0))(cx, idx)
                     by = jax.vmap(lambda y, i: jnp.take(y, i, axis=0))(cy, idx)
                     bm = jax.vmap(lambda m, i: jnp.take(m, i, axis=0))(cmask, idx)
+                    if preprocess is not None:
+                        bx = jax.vmap(preprocess)(bx)
                     # Per-client gradients at the SHARED params.
                     (losses, _), grads = jax.vmap(
                         grad_fn, in_axes=(None, 0, 0, 0)

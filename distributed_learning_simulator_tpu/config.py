@@ -42,6 +42,12 @@ class ExperimentConfig:
     # --- data partitioning (data/partition.py) -----------------------------
     partition: str = "iid"  # iid | dirichlet
     dirichlet_alpha: float = 0.1
+    # Cap on the packed per-client shard size. Every client scans
+    # max-shard-size batches per epoch (fixed shapes), so one giant client
+    # under extreme Dirichlet skew multiplies EVERY client's step count;
+    # capping truncates outlier shards (their extra samples are dropped).
+    # None = no cap.
+    max_shard_size: int | None = None
     n_train: int | None = None  # subsample for fast runs/tests
     n_test: int | None = None
     data_dir: str | None = None
@@ -72,6 +78,9 @@ class ExperimentConfig:
     participation_fraction: float = 1.0
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
+    # Store packed client shards as uint8-flattened arrays (4x less HBM,
+    # TPU-friendly tiling); batches are decoded on the fly in the step.
+    compact_client_data: bool = True
     eval_batch_size: int = 512
     log_root: str = "log"
     checkpoint_dir: str | None = None
@@ -101,10 +110,11 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
         elif f.name in ("n_train", "n_test", "mesh_devices"):
             parser.add_argument(arg, type=int, default=None)
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
-                        "profile_dir", "client_chunk_size"):
+                        "profile_dir", "client_chunk_size", "max_shard_size"):
             typ = {
                 "round_trunc_threshold": float,
                 "client_chunk_size": int,
+                "max_shard_size": int,
             }.get(f.name, str)
             parser.add_argument(arg, type=typ, default=None)
         else:

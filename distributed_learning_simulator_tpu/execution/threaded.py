@@ -40,6 +40,7 @@ from distributed_learning_simulator_tpu.data.registry import Dataset, get_datase
 from distributed_learning_simulator_tpu.models.registry import get_model, init_params
 from distributed_learning_simulator_tpu.ops.aggregate import weighted_mean
 from distributed_learning_simulator_tpu.parallel.engine import (
+    make_decoder,
     make_eval_fn,
     make_local_train_fn,
     make_optimizer,
@@ -190,6 +191,10 @@ def run_threaded_simulation(
         make_local_train_fn(
             model.apply, optimizer, local_epochs=config.epoch,
             batch_size=config.batch_size, reset_optimizer=True,
+            preprocess=(
+                make_decoder(client_data.sample_shape)
+                if client_data.compact else None
+            ),
         )
     )
     evaluate = jax.jit(make_eval_fn(model.apply))

@@ -68,6 +68,18 @@ def make_loss_fn(apply_fn, param_transform: Callable | None = None):
     return loss_fn
 
 
+def make_decoder(sample_shape):
+    """Batch decoder for compact (uint8-flattened) client storage: cast,
+    rescale to [0, 1], restore the sample shape. See ClientData.compact."""
+
+    def decode(b):
+        return (b.astype(jnp.float32) / 255.0).reshape(
+            (b.shape[0],) + tuple(sample_shape)
+        )
+
+    return decode
+
+
 def make_local_train_fn(
     apply_fn,
     optimizer,
@@ -75,6 +87,7 @@ def make_local_train_fn(
     batch_size: int,
     param_transform: Callable | None = None,
     reset_optimizer: bool = True,
+    preprocess: Callable | None = None,
 ):
     """Build ``local_train(params, opt_state, xs, ys, mask, key)``.
 
@@ -95,6 +108,10 @@ def make_local_train_fn(
         shard_size = xs.shape[0]
         steps_per_epoch = shard_size // batch_size
         if reset_optimizer:
+            # Fresh optimizer every round (standard FedAvg). The incoming
+            # opt_state is ignored and None is returned in its place — at
+            # 1000-client scale a returned per-client optimizer state would
+            # be dead weight the size of the whole model per client.
             opt_state = optimizer.init(params)
 
         def epoch_body(carry, epoch_key):
@@ -109,6 +126,8 @@ def make_local_train_fn(
                 bx = jnp.take(xs, idx, axis=0)
                 by = jnp.take(ys, idx, axis=0)
                 bm = jnp.take(mask, idx, axis=0)
+                if preprocess is not None:
+                    bx = preprocess(bx)
                 (loss, acc), grads = grad_fn(params, bx, by, bm)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
@@ -124,7 +143,7 @@ def make_local_train_fn(
             epoch_body, (params, opt_state), epoch_keys
         )
         metrics = {"loss": epoch_losses[-1], "accuracy": epoch_accs[-1]}
-        return params, opt_state, metrics
+        return params, (None if reset_optimizer else opt_state), metrics
 
     return local_train
 

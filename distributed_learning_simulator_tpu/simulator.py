@@ -39,6 +39,7 @@ from distributed_learning_simulator_tpu.data.registry import Dataset, get_datase
 from distributed_learning_simulator_tpu.factory import get_algorithm
 from distributed_learning_simulator_tpu.models.registry import get_model, init_params
 from distributed_learning_simulator_tpu.parallel.engine import (
+    make_decoder,
     make_eval_fn,
     make_optimizer,
     pad_eval_set,
@@ -75,8 +76,13 @@ def build_client_data(config: ExperimentConfig, dataset: Dataset) -> ClientData:
             dataset.y_train, config.worker_number, config.dirichlet_alpha,
             seed=config.seed,
         )
+    max_size = getattr(config, "max_shard_size", None)
+    if max_size:
+        indices = [ix[:max_size] for ix in indices]
     return pack_client_shards(
-        dataset.x_train, dataset.y_train, indices, batch_size=config.batch_size
+        dataset.x_train, dataset.y_train, indices,
+        batch_size=config.batch_size,
+        compact=getattr(config, "compact_client_data", True),
     )
 
 
@@ -132,7 +138,12 @@ def run_simulation(
 
     evaluate = jax.jit(make_eval_fn(model.apply))
     algorithm.prepare(model.apply, make_eval_fn(model.apply))
-    round_fn = algorithm.make_round_fn(model.apply, optimizer, n_clients)
+    preprocess = (
+        make_decoder(client_data.sample_shape) if client_data.compact else None
+    )
+    round_fn = algorithm.make_round_fn(
+        model.apply, optimizer, n_clients, preprocess=preprocess
+    )
     round_jit = jax.jit(round_fn, donate_argnums=(1,))
 
     # --- resume (before placement, so restored state gets sharded too) ------

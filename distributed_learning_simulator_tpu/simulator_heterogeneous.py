@@ -46,7 +46,7 @@ def run_heterogeneous(
         bad_dataset_name, data_dir=config.data_dir, seed=config.seed + 1,
         n_train=client_data.shard_size, to_grayscale=True,
     )
-    target_shape = client_data.x.shape[2:]  # (H, W, C)
+    target_shape = client_data.sample_shape or client_data.x.shape[2:]
     bad_x = _fit_images(bad.x_train, target_shape)
     get_logger().info(
         "client %d gets %d samples of bad dataset %r (others keep %s shards)",

@@ -147,3 +147,24 @@ def test_heterogeneous_entry_point(tiny_config, tmp_path):
     cfg = dataclasses.replace(tiny_config, log_root=str(tmp_path), round=2)
     res = run_heterogeneous(cfg, bad_dataset_name="synthetic")
     assert res["final_accuracy"] is not None
+
+
+def test_compact_storage_matches_float(tiny_config):
+    """uint8-flat client storage is an execution detail; with 8-bit-exact
+    inputs the trajectories should be near-identical to float32 storage."""
+    base = _run(tiny_config, compact_client_data=False, round=2)
+    compact = _run(tiny_config, compact_client_data=True, round=2)
+    a = [h["test_accuracy"] for h in base["history"]]
+    b = [h["test_accuracy"] for h in compact["history"]]
+    np.testing.assert_allclose(b, a, atol=0.02)
+
+
+def test_max_shard_size_caps(tiny_config, tiny_dataset):
+    from distributed_learning_simulator_tpu.simulator import build_client_data
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_config, max_shard_size=64)
+    cd = build_client_data(cfg, tiny_dataset)
+    assert cd.shard_size == 64
+    res = _run(tiny_config, max_shard_size=64, round=2)
+    assert res["final_accuracy"] is not None
