@@ -51,7 +51,7 @@ class FedAvg(Algorithm):
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
         keep = self.keep_client_params
         chunk = cfg.client_chunk_size
-        frac = getattr(cfg, "participation_fraction", 1.0)
+        frac = cfg.participation_fraction
         n_participants = (
             n_clients if frac >= 1.0 else max(1, round(frac * n_clients))
         )
@@ -92,14 +92,19 @@ class FedAvg(Algorithm):
                     cp,
                 )
 
-            if chunk is None or chunk >= k or k % chunk != 0:
+            if chunk is None or chunk >= k:
                 cp, ns, tm = train_clients(global_params, state, x, y, m, keys)
                 return reduce_chunk(cp, norm_w, payload_key), ns, tm
 
-            n_chunks = k // chunk
+            # Remainder participants (k % chunk) get their own vmap call so
+            # the memory-safe path never silently degrades to materializing
+            # the full per-client param stack.
+            n_chunks, rem = divmod(k, chunk)
+            trees = (state, x, y, m, keys, norm_w)
+            head = jax.tree_util.tree_map(lambda a: a[: k - rem], trees)
             resh = lambda a: a.reshape((n_chunks, chunk) + a.shape[1:])
-            xs = jax.tree_util.tree_map(resh, (state, x, y, m, keys, norm_w))
-            payload_keys = jax.random.split(payload_key, n_chunks)
+            xs = jax.tree_util.tree_map(resh, head)
+            payload_keys = jax.random.split(payload_key, n_chunks + 1)
 
             def body(acc, args):
                 (state_c, x_c, y_c, m_c, keys_c, w_c), pk = args
@@ -110,10 +115,24 @@ class FedAvg(Algorithm):
                 return acc, (ns, tm)
 
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, global_params)
-            agg, (ns, tm) = jax.lax.scan(body, acc0, (xs, payload_keys))
-            unresh = lambda a: a.reshape((k,) + a.shape[2:])
+            agg, (ns, tm) = jax.lax.scan(
+                body, acc0, (xs, payload_keys[:n_chunks])
+            )
+            unresh = lambda a: a.reshape((k - rem,) + a.shape[2:])
             ns = jax.tree_util.tree_map(unresh, ns)
             tm = jax.tree_util.tree_map(unresh, tm)
+            if rem:
+                state_t, x_t, y_t, m_t, keys_t, w_t = jax.tree_util.tree_map(
+                    lambda a: a[k - rem:], trees
+                )
+                cp_t, ns_t, tm_t = vtrain(global_params, state_t, x_t, y_t,
+                                          m_t, keys_t)
+                agg = jax.tree_util.tree_map(
+                    jnp.add, agg, reduce_chunk(cp_t, w_t, payload_keys[-1])
+                )
+                cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+                ns = jax.tree_util.tree_map(cat, ns, ns_t)
+                tm = jax.tree_util.tree_map(cat, tm, tm_t)
             return agg, ns, tm
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
@@ -134,7 +153,8 @@ class FedAvg(Algorithm):
                 state_k = jax.tree_util.tree_map(take, client_state)
                 x_k, y_k, m_k = take(cx), take(cy), take(cmask)
                 part_sizes = jnp.take(sizes, idx, axis=0)
-            norm_w = part_sizes / jnp.sum(part_sizes)
+            total_size = jnp.sum(part_sizes)
+            norm_w = part_sizes / jnp.maximum(total_size, 1e-12)
 
             aux = {}
             if keep:
@@ -154,6 +174,15 @@ class FedAvg(Algorithm):
                     norm_w, payload_key,
                 )
                 payload_aux = {}
+            # Empty effective cohort (all sampled clients have zero samples,
+            # possible under extreme Dirichlet skew): keep the previous
+            # global model, parity with fed_server.py:45-47.
+            new_global = jax.tree_util.tree_map(
+                lambda agg, prev: jnp.where(
+                    total_size > 0, agg, prev.astype(agg.dtype)
+                ),
+                new_global, global_params,
+            )
             new_global, agg_aux = self.process_aggregated(new_global, agg_key)
             if idx is not None:
                 new_state = jax.tree_util.tree_map(

@@ -66,8 +66,7 @@ class ClientData:
         n = min(len(x), self.shard_size)
         xr = x[:n]
         if self.compact:
-            xr = np.round(np.clip(xr, 0.0, 1.0) * 255.0).astype(np.uint8)
-            xr = xr.reshape(n, -1)
+            xr = _compact_encode(xr, n, self.x.shape[-1])
         self.x[client_id] = 0
         self.y[client_id] = 0
         self.mask[client_id] = 0.0
@@ -76,6 +75,12 @@ class ClientData:
         self.mask[client_id, :n] = 1.0
         self.sizes[client_id] = float(n)
         return self
+
+
+def _compact_encode(x: np.ndarray, n: int, dim: int) -> np.ndarray:
+    """uint8 flatten for compact storage; inverse is cast * (1/255) + reshape
+    (parallel/engine.py make_decoder)."""
+    return np.round(np.clip(x, 0.0, 1.0) * 255.0).astype(np.uint8).reshape(n, dim)
 
 
 def iid_partition(n_samples: int, n_clients: int, seed: int = 0) -> list[np.ndarray]:
@@ -136,6 +141,16 @@ def pack_client_shards(
     carry mask 0 and contribute nothing to the loss). ``compact`` stores
     uint8-flattened samples (see :class:`ClientData`).
     """
+    if compact and (x.min() < -1e-6 or x.max() > 1.0 + 1e-6):
+        from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "compact uint8 client storage assumes inputs in [0, 1] but data "
+            "range is [%.4g, %.4g]; falling back to float32 storage "
+            "(set compact_client_data=False to silence)",
+            float(x.min()), float(x.max()),
+        )
+        compact = False
     n_clients = len(indices)
     max_n = max(len(ix) for ix in indices)
     size = shard_size or max_n
@@ -153,8 +168,7 @@ def pack_client_shards(
         n = min(len(ix), size)
         xi = x[ix[:n]]
         if compact:
-            xi = np.round(np.clip(xi, 0.0, 1.0) * 255.0).astype(np.uint8)
-            xi = xi.reshape(n, dim)
+            xi = _compact_encode(xi, n, dim)
         cx[i, :n] = xi
         cy[i, :n] = y[ix[:n]]
         mask[i, :n] = 1.0

@@ -34,7 +34,11 @@ def weighted_mean(stacked_tree, weights):
     fed_server.py:58-66); they are normalized internally.
     """
     weights = jnp.asarray(weights, dtype=jnp.float32)
-    w = weights / jnp.sum(weights)
+    # All-zero weights (e.g. a sampled cohort of only empty Dirichlet
+    # clients) must not produce NaN; the caller decides the fallback
+    # (round_fn keeps the previous global model, parity with
+    # fed_server.py:45-47's empty-subset behavior).
+    w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
     return jax.tree_util.tree_map(
         lambda x: jnp.tensordot(w.astype(x.dtype), x, axes=(0, 0)), stacked_tree
     )

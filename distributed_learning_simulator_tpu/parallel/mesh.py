@@ -29,10 +29,33 @@ def make_mesh(num_devices: int | None = None, axis_name: str = CLIENT_AXIS) -> M
     devices = jax.devices()
     if num_devices is not None:
         if num_devices > len(devices):
-            raise ValueError(
-                f"requested {num_devices} mesh devices but only "
-                f"{len(devices)} visible"
-            )
+            # A TPU plugin may take platform priority over JAX_PLATFORMS=cpu;
+            # the virtual-CPU devices (xla_force_host_platform_device_count)
+            # are still reachable through the explicit cpu backend.
+            try:
+                cpu_devices = jax.devices("cpu")
+            except RuntimeError:
+                cpu_devices = []
+            if num_devices <= len(cpu_devices):
+                from distributed_learning_simulator_tpu.utils.logging import (
+                    get_logger,
+                )
+
+                get_logger().warning(
+                    "mesh fallback: %d devices requested but only %d on "
+                    "platform %r; using %d virtual HOST-CPU devices "
+                    "(orders of magnitude slower than accelerators — "
+                    "intended for sharding validation, not production)",
+                    num_devices, len(devices), devices[0].platform,
+                    num_devices,
+                )
+                devices = cpu_devices
+            else:
+                raise ValueError(
+                    f"requested {num_devices} mesh devices but only "
+                    f"{len(devices)} visible "
+                    f"(and {len(cpu_devices)} cpu devices)"
+                )
         devices = devices[:num_devices]
     return Mesh(np.array(devices), (axis_name,))
 

@@ -76,13 +76,18 @@ def build_client_data(config: ExperimentConfig, dataset: Dataset) -> ClientData:
             dataset.y_train, config.worker_number, config.dirichlet_alpha,
             seed=config.seed,
         )
-    max_size = getattr(config, "max_shard_size", None)
-    if max_size:
-        indices = [ix[:max_size] for ix in indices]
+    if config.max_shard_size:
+        # Unbiased cap: partition index lists are dataset-ordered, so a
+        # plain [:cap] would keep only low-index samples (dropping whole
+        # classes on class-ordered datasets).
+        rng = np.random.default_rng(config.seed + 17)
+        indices = [
+            rng.permutation(ix)[: config.max_shard_size] for ix in indices
+        ]
     return pack_client_shards(
         dataset.x_train, dataset.y_train, indices,
         batch_size=config.batch_size,
-        compact=getattr(config, "compact_client_data", True),
+        compact=config.compact_client_data,
     )
 
 

@@ -117,6 +117,35 @@ def test_client_chunking_matches_unchunked(tiny_config):
     np.testing.assert_allclose(b, a, atol=1e-5)
 
 
+def test_client_chunking_remainder_matches(tiny_config):
+    """Chunk size that does not divide the cohort must still use the fused
+    memory-safe path and match the unchunked result (8 % 3 == 2)."""
+    base = _run(tiny_config, worker_number=8, round=2)
+    chunked = _run(tiny_config, worker_number=8, round=2, client_chunk_size=3)
+    a = [h["test_accuracy"] for h in base["history"]]
+    b = [h["test_accuracy"] for h in chunked["history"]]
+    np.testing.assert_allclose(b, a, atol=1e-5)
+
+
+def test_all_empty_cohort_keeps_model(tiny_config, tiny_dataset):
+    """A round whose every participant has zero samples (possible under
+    extreme Dirichlet skew + sampling) must keep the previous global model,
+    not NaN it (parity with reference fed_server.py:45-47 empty-subset)."""
+    import jax
+
+    from distributed_learning_simulator_tpu.simulator import build_client_data
+
+    cd = build_client_data(tiny_config, tiny_dataset)
+    cd.mask[:] = 0.0
+    cd.sizes[:] = 0.0
+    res = run_simulation(tiny_config, dataset=tiny_dataset, client_data=cd,
+                         setup_logging=False)
+    for leaf in jax.tree_util.tree_leaves(res["global_params"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+    accs = [h["test_accuracy"] for h in res["history"]]
+    assert accs[0] == accs[-1]  # model never moved
+
+
 def test_participation_sampling(tiny_config):
     """Client sampling: cohort of half the clients per round still learns,
     and Shapley refuses partial participation."""
