@@ -29,8 +29,11 @@ def main():
     )
 
     n_clients = int(os.environ.get("BENCH_CLIENTS", "1000"))
-    n_rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
-    model = os.environ.get("BENCH_MODEL", "cnn")
+    n_rounds = int(os.environ.get("BENCH_ROUNDS", "50"))
+    # cnn_tpu: the MXU-aligned CIFAR CNN (models/cnn.py::TpuCifarCNN) —
+    # same capability slot as the reference's CIFAR CNN, ~5.7x faster per
+    # round than the 3->32->64->128 NHWC variant on TPU (layout note there).
+    model = os.environ.get("BENCH_MODEL", "cnn_tpu")
     # 50k CIFAR samples / 1000 clients = 50 per shard; batch 25 -> two full
     # steps per local epoch with zero padding waste.
     batch = int(os.environ.get("BENCH_BATCH", "25"))

@@ -48,6 +48,12 @@ class Algorithm:
     name: str = ""
     # Shapley algorithms need the stacked per-client params in round output.
     keep_client_params: bool = False
+    # Whether the host round loop may defer this algorithm's metric fetch +
+    # post_round by one round (hides device->host latency behind the next
+    # round's compute). Safe when post_round is analytic/logging-only; the
+    # Shapley algorithms set False — their post_round drives data-dependent
+    # subset evaluation that must see the round's metrics synchronously.
+    supports_round_pipelining: bool = True
 
     def __init__(self, config):
         self.config = config

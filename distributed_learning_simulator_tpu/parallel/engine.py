@@ -148,10 +148,32 @@ def make_local_train_fn(
     return local_train
 
 
-def pad_eval_set(x, y, batch_size: int):
+def make_reshaper(sample_shape):
+    """Batch preprocess for flattened eval storage: restore sample shape.
+
+    Feeding eval batches as ``[B, prod(shape)]`` instead of ``[B, H, W, C]``
+    matters on TPU: device arrays are tiled (8, 128) over the trailing two
+    dims, so an explicit 3-channel NHWC input buffer pads its lane dim
+    3 -> 128 (a ~40x HBM inflation); a flat last dim has no such padding,
+    and XLA picks good layouts for the in-program reshape.
+    """
+
+    def reshape(b):
+        return b.reshape((b.shape[0],) + tuple(sample_shape))
+
+    return reshape
+
+
+def pad_eval_set(x, y, batch_size: int, flatten: bool = False):
     """Host-side: pad + reshape a test set to ``[n_batches, batch_size, ...]``
-    with a mask, so evaluation is a fixed-shape ``lax.scan``."""
+    with a mask, so evaluation is a fixed-shape ``lax.scan``.
+
+    ``flatten=True`` stores samples flattened to 1-D (pair with
+    ``make_reshaper`` as the eval preprocess — see its TPU layout note).
+    """
     n = x.shape[0]
+    if flatten:
+        x = x.reshape(n, -1)
     n_batches = (n + batch_size - 1) // batch_size
     padded = n_batches * batch_size
     xp = np.zeros((padded,) + x.shape[1:], dtype=x.dtype)
@@ -165,17 +187,20 @@ def pad_eval_set(x, y, batch_size: int):
     )
 
 
-def make_eval_fn(apply_fn):
+def make_eval_fn(apply_fn, preprocess: Callable | None = None):
     """Build ``evaluate(params, xb, yb, mb) -> {"loss", "accuracy"}``.
 
     Full-test-set inference as a scan over pre-padded batches; parity with the
     reference's per-round server-side evaluation (``get_metric`` ->
     ``tester.inference()``, fed_server.py:26-32,85-86). vmap-able over a
-    params batch for Shapley subset evaluation.
+    params batch for Shapley subset evaluation. ``preprocess`` is applied to
+    each x batch inside the scan (e.g. ``make_reshaper`` for flat storage).
     """
     def evaluate(params, xb, yb, mb):
         def body(carry, batch):
             x, y, m = batch
+            if preprocess is not None:
+                x = preprocess(x)
             logits = apply_fn({"params": params}, x)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32))
             nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]

@@ -13,6 +13,8 @@ spans all processes' devices.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -31,12 +33,18 @@ def make_mesh(num_devices: int | None = None, axis_name: str = CLIENT_AXIS) -> M
         if num_devices > len(devices):
             # A TPU plugin may take platform priority over JAX_PLATFORMS=cpu;
             # the virtual-CPU devices (xla_force_host_platform_device_count)
-            # are still reachable through the explicit cpu backend.
+            # are still reachable through the explicit cpu backend. Opt-in
+            # only (DLS_ALLOW_CPU_MESH_FALLBACK=1): a production launch with
+            # a device shortfall must fail fast, not quietly train on host
+            # CPU. dryrun/sharding-validation entry points set the flag.
+            allow_fallback = os.environ.get(
+                "DLS_ALLOW_CPU_MESH_FALLBACK", ""
+            ).lower() in ("1", "true")
             try:
                 cpu_devices = jax.devices("cpu")
             except RuntimeError:
                 cpu_devices = []
-            if num_devices <= len(cpu_devices):
+            if allow_fallback and num_devices <= len(cpu_devices):
                 from distributed_learning_simulator_tpu.utils.logging import (
                     get_logger,
                 )
@@ -54,7 +62,9 @@ def make_mesh(num_devices: int | None = None, axis_name: str = CLIENT_AXIS) -> M
                 raise ValueError(
                     f"requested {num_devices} mesh devices but only "
                     f"{len(devices)} visible "
-                    f"(and {len(cpu_devices)} cpu devices)"
+                    f"(and {len(cpu_devices)} cpu devices; set "
+                    "DLS_ALLOW_CPU_MESH_FALLBACK=1 to validate sharding on "
+                    "virtual host-CPU devices)"
                 )
         devices = devices[:num_devices]
     return Mesh(np.array(devices), (axis_name,))

@@ -42,6 +42,7 @@ from distributed_learning_simulator_tpu.parallel.engine import (
     make_decoder,
     make_eval_fn,
     make_optimizer,
+    make_reshaper,
     pad_eval_set,
 )
 from distributed_learning_simulator_tpu.parallel.mesh import (
@@ -128,9 +129,12 @@ def run_simulation(
     if client_data is None:
         client_data = build_client_data(config, dataset)
     n_clients = client_data.n_clients
+    # Flat eval storage + in-program reshape: see make_reshaper's TPU
+    # layout note (explicit NHWC input buffers pad 3-channel lanes to 128).
     eval_batches_np = pad_eval_set(
-        dataset.x_test, dataset.y_test, config.eval_batch_size
+        dataset.x_test, dataset.y_test, config.eval_batch_size, flatten=True
     )
+    eval_preprocess = make_reshaper(dataset.x_test.shape[1:])
 
     # --- model / optimizer / algorithm --------------------------------------
     model = get_model(config.model_name, num_classes=dataset.num_classes)
@@ -141,8 +145,10 @@ def run_simulation(
     )
     algorithm = get_algorithm(config.distributed_algorithm, config)
 
-    evaluate = jax.jit(make_eval_fn(model.apply))
-    algorithm.prepare(model.apply, make_eval_fn(model.apply))
+    evaluate = jax.jit(make_eval_fn(model.apply, preprocess=eval_preprocess))
+    algorithm.prepare(
+        model.apply, make_eval_fn(model.apply, preprocess=eval_preprocess)
+    )
     preprocess = (
         make_decoder(client_data.sample_shape) if client_data.compact else None
     )
@@ -207,70 +213,134 @@ def run_simulation(
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
         metrics_path = os.path.join(log_dir, "metrics.jsonl")
+
+    # Pipelined mode defers each round's device->host metric fetch until the
+    # NEXT round has been dispatched, so transfer latency (a full RTT when
+    # the chip sits behind a network tunnel) overlaps device compute. Results
+    # are bit-identical to the synchronous path — only fetch timing moves.
+    # Not used when post_round must see metrics in the same round (Shapley),
+    # nor when per-client state is checkpointed (the state buffer for round
+    # r is donated to round r+1's dispatch before r's checkpoint would run).
+    checkpointing = bool(config.checkpoint_dir and config.checkpoint_every)
+    pipelined = (
+        config.pipeline_rounds
+        and getattr(algorithm, "supports_round_pipelining", False)
+        and not (checkpointing and client_state is not None)
+    )
     t_start = time.perf_counter()
+    t_prev_done = t_start
+    pending: dict | None = None
+
+    def finalize(p: dict) -> None:
+        nonlocal prev_metrics, t_prev_done
+        fetched_metrics, fetched_loss = jax.device_get(
+            (p["metrics_dev"], p["mean_loss_dev"])
+        )
+        metrics = {k: float(v) for k, v in fetched_metrics.items()}
+        ctx = RoundContext(
+            round_idx=p["round_idx"],
+            global_params=p["new_global"],
+            prev_global_params=p["prev_global"],
+            sizes=sizes,
+            aux=p["aux"],
+            metrics=metrics,
+            prev_metrics=prev_metrics,
+            eval_batches=eval_batches,
+            log_dir=log_dir,
+        )
+        with annotate("post_round"):
+            extra = algorithm.post_round(ctx) or {}
+        now = time.perf_counter()
+        record = {
+            "round": p["round_idx"],
+            "test_accuracy": metrics["accuracy"],
+            "test_loss": metrics["loss"],
+            "mean_client_loss": float(fetched_loss),
+            # Wall time between successive round completions: covers train +
+            # eval + metric fetch + host post_round (Shapley time included —
+            # it IS per-round server work). Sums to total wall time.
+            "round_seconds": now - t_prev_done,
+            **{
+                k: v for k, v in extra.items()
+                if isinstance(v, (int, float, dict))
+            },
+        }
+        t_prev_done = now
+        history.append(record)
+        if metrics_path:
+            with open(metrics_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        logger.info(
+            "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
+            p["round_idx"], metrics["accuracy"], metrics["loss"],
+            record["round_seconds"],
+        )
+        prev_metrics = metrics
+
+        if (
+            checkpointing
+            and (p["round_idx"] + 1) % config.checkpoint_every == 0
+        ):
+            algo_state = {"prev_metrics": metrics}
+            if hasattr(algorithm, "shapley_values"):
+                algo_state["shapley_values"] = algorithm.shapley_values
+            save_checkpoint(
+                os.path.join(
+                    config.checkpoint_dir, f"round_{p['round_idx']}.ckpt"
+                ),
+                p["round_idx"], p["new_global"], p["client_state"],
+                algo_state, p["key"],
+            )
+
     with profile_session(config.profile_dir):
-        for round_idx in range(start_round, config.round):
-            key, round_key = jax.random.split(key)
-            t0 = time.perf_counter()
-            with annotate(f"fl_round_{round_idx}"):
-                new_global, client_state, aux = round_jit(
-                    global_params, client_state, cx, cy, cmask, sizes,
-                    round_key,
-                )
-            with annotate("server_eval"):
-                metrics_dev = evaluate(new_global, *eval_batches)
-            metrics = {k: float(v) for k, v in metrics_dev.items()}
-            round_time = time.perf_counter() - t0
-
-            ctx = RoundContext(
-                round_idx=round_idx,
-                global_params=new_global,
-                prev_global_params=global_params,
-                sizes=sizes,
-                aux=aux,
-                metrics=metrics,
-                prev_metrics=prev_metrics,
-                eval_batches=eval_batches,
-                log_dir=log_dir,
-            )
-            with annotate("post_round"):
-                extra = algorithm.post_round(ctx) or {}
-            record = {
-                "round": round_idx,
-                "test_accuracy": metrics["accuracy"],
-                "test_loss": metrics["loss"],
-                "mean_client_loss": float(aux.get("mean_client_loss", np.nan)),
-                "round_seconds": round_time,
-                **{
-                    k: v for k, v in extra.items()
-                    if isinstance(v, (int, float, dict))
-                },
-            }
-            history.append(record)
-            if metrics_path:
-                with open(metrics_path, "a") as f:
-                    f.write(json.dumps(record) + "\n")
-            logger.info(
-                "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
-                round_idx, metrics["accuracy"], metrics["loss"], round_time,
-            )
-            global_params = new_global
-            prev_metrics = metrics
-
-            if (
-                config.checkpoint_dir
-                and config.checkpoint_every
-                and (round_idx + 1) % config.checkpoint_every == 0
-            ):
-                algo_state = {"prev_metrics": metrics}
-                if hasattr(algorithm, "shapley_values"):
-                    algo_state["shapley_values"] = algorithm.shapley_values
-                save_checkpoint(
-                    os.path.join(
-                        config.checkpoint_dir, f"round_{round_idx}.ckpt"
-                    ),
-                    round_idx, global_params, client_state, algo_state, key,
-                )
+        # try/finally: if a later round crashes (OOM, preemption, SIGINT),
+        # the deferred round that already completed on device still gets its
+        # metrics line and checkpoint written before unwinding.
+        try:
+            for round_idx in range(start_round, config.round):
+                key, round_key = jax.random.split(key)
+                with annotate(f"fl_round_{round_idx}"):
+                    new_global, client_state, aux = round_jit(
+                        global_params, client_state, cx, cy, cmask, sizes,
+                        round_key,
+                    )
+                with annotate("server_eval"):
+                    metrics_dev = evaluate(new_global, *eval_batches)
+                entry = {
+                    "round_idx": round_idx,
+                    "new_global": new_global,
+                    "prev_global": global_params,
+                    "client_state": None if pipelined else client_state,
+                    "aux": aux,
+                    "metrics_dev": metrics_dev,
+                    "mean_loss_dev": aux.get("mean_client_loss", np.nan),
+                    "key": key,
+                }
+                global_params = new_global
+                if pipelined:
+                    # Take ownership of `entry` before finalizing the prior
+                    # round: if that finalize raises, the finally block still
+                    # records this round (the raising round is what's lost).
+                    prev_pending, pending = pending, entry
+                    if prev_pending is not None:
+                        finalize(prev_pending)
+                else:
+                    finalize(entry)
+        finally:
+            if pending is not None:
+                # Crash-flush of the last deferred round. Best-effort: if
+                # finalize itself is what failed in-loop (full disk, post_round
+                # bug), don't let a second failure here supersede the original
+                # exception in the propagated traceback.
+                try:
+                    finalize(pending)
+                except Exception:
+                    logger.exception(
+                        "failed to record round %d during unwind",
+                        pending["round_idx"],
+                    )
+                finally:
+                    pending = None
 
     total = time.perf_counter() - t_start
     n_rounds = config.round - start_round

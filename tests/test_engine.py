@@ -10,6 +10,7 @@ from distributed_learning_simulator_tpu.parallel.engine import (
     make_local_train_fn,
     make_loss_fn,
     make_optimizer,
+    make_reshaper,
     pad_eval_set,
 )
 
@@ -84,3 +85,26 @@ def test_pad_eval_set_shapes():
     xb, yb, mb = pad_eval_set(x, y, 4)
     assert xb.shape == (3, 4, 3, 3, 1)
     assert mb.sum() == 10
+
+
+def test_flattened_eval_matches_unflattened(tiny_dataset):
+    """Flat eval storage + in-program reshape (the TPU layout path) must give
+    identical metrics to direct NHWC batches."""
+    model, params = _setup(tiny_dataset)
+    direct = pad_eval_set(tiny_dataset.x_test, tiny_dataset.y_test, 100)
+    out1 = jax.jit(make_eval_fn(model.apply))(
+        params, *(jnp.asarray(a) for a in direct)
+    )
+    flat = pad_eval_set(tiny_dataset.x_test, tiny_dataset.y_test, 100,
+                        flatten=True)
+    assert flat[0].ndim == 3  # [n_batches, batch, prod(sample_shape)]
+    reshaper = make_reshaper(tiny_dataset.x_test.shape[1:])
+    out2 = jax.jit(make_eval_fn(model.apply, preprocess=reshaper))(
+        params, *(jnp.asarray(a) for a in flat)
+    )
+    np.testing.assert_allclose(
+        float(out1["accuracy"]), float(out2["accuracy"]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(out1["loss"]), float(out2["loss"]), atol=1e-5
+    )
