@@ -50,7 +50,10 @@ def main():
         momentum=0.9,
         batch_size=batch,
         log_level="WARNING",
-        eval_batch_size=1024,
+        # Whole test set as one eval batch: the per-iteration overhead of a
+        # 10-step eval scan costs more than the memory a single 10k-sample
+        # forward needs (measured 19ms vs 28-34ms per round on one chip).
+        eval_batch_size=10000,
         client_chunk_size=chunk,
     )
     dataset = get_dataset(config.dataset_name, seed=config.seed)

@@ -66,8 +66,8 @@ class ClientData:
         n = min(len(x), self.shard_size)
         xr = x[:n]
         if self.compact:
-            xmin, xmax = float(xr.min()), float(xr.max())
-            if xmin < -1e-6 or xmax > 1.0 + 1e-6:
+            ok, xmin, xmax = _unit_range(xr)
+            if not ok:
                 raise ValueError(
                     "override_client on a compact-packed ClientData requires "
                     f"data in [0, 1]; got range [{xmin:.4g}, {xmax:.4g}]. "
@@ -88,6 +88,18 @@ def _compact_encode(x: np.ndarray, n: int, dim: int) -> np.ndarray:
     """uint8 flatten for compact storage; inverse is cast * (1/255) + reshape
     (parallel/engine.py make_decoder)."""
     return np.round(np.clip(x, 0.0, 1.0) * 255.0).astype(np.uint8).reshape(n, dim)
+
+
+def _unit_range(x: np.ndarray) -> tuple[bool, float, float]:
+    """Single source of truth for the compact-storage [0, 1] range contract.
+
+    Returns (within_range, min, max); empty arrays are trivially in range
+    (nothing to encode).
+    """
+    if x.size == 0:
+        return True, 0.0, 0.0
+    xmin, xmax = float(x.min()), float(x.max())
+    return xmin >= -1e-6 and xmax <= 1.0 + 1e-6, xmin, xmax
 
 
 def iid_partition(n_samples: int, n_clients: int, seed: int = 0) -> list[np.ndarray]:
@@ -149,8 +161,8 @@ def pack_client_shards(
     uint8-flattened samples (see :class:`ClientData`).
     """
     if compact:
-        xmin, xmax = float(x.min()), float(x.max())
-        if xmin < -1e-6 or xmax > 1.0 + 1e-6:
+        ok, xmin, xmax = _unit_range(x)
+        if not ok:
             from distributed_learning_simulator_tpu.utils.logging import (
                 get_logger,
             )

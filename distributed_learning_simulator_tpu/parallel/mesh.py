@@ -59,12 +59,16 @@ def make_mesh(num_devices: int | None = None, axis_name: str = CLIENT_AXIS) -> M
                 )
                 devices = cpu_devices
             else:
+                hint = (
+                    "raise XLA_FLAGS=--xla_force_host_platform_device_count"
+                    if allow_fallback
+                    else "set DLS_ALLOW_CPU_MESH_FALLBACK=1 to validate "
+                    "sharding on virtual host-CPU devices"
+                )
                 raise ValueError(
                     f"requested {num_devices} mesh devices but only "
                     f"{len(devices)} visible "
-                    f"(and {len(cpu_devices)} cpu devices; set "
-                    "DLS_ALLOW_CPU_MESH_FALLBACK=1 to validate sharding on "
-                    "virtual host-CPU devices)"
+                    f"(and {len(cpu_devices)} cpu devices; {hint})"
                 )
         devices = devices[:num_devices]
     return Mesh(np.array(devices), (axis_name,))

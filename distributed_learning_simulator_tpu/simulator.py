@@ -224,7 +224,7 @@ def run_simulation(
     checkpointing = bool(config.checkpoint_dir and config.checkpoint_every)
     pipelined = (
         config.pipeline_rounds
-        and getattr(algorithm, "supports_round_pipelining", False)
+        and algorithm.supports_round_pipelining
         and not (checkpointing and client_state is not None)
     )
     t_start = time.perf_counter()

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Quantized FedAvg: straight-through-estimator QAT in the client loss,
+# 256-level stochastic-rounded parameter exchange both directions, analytic
+# compression-ratio reporting (history rows carry uplink/downlink ratios).
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name mnist --model_name lenet5 \
+  --distributed_algorithm fed_quant \
+  --worker_number 8 --round 5 --epoch 1 --learning_rate 0.1 \
+  --quant_levels 256 --log_level INFO

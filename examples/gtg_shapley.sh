@@ -1,0 +1,9 @@
+#!/bin/bash
+# GTG-Shapley Monte-Carlo contribution scoring: permutation sampling with
+# guided truncation; per-round Shapley values logged and subset metrics
+# pickled to the run's artifact dir.
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name mnist --model_name lenet5 \
+  --distributed_algorithm GTG_shapley_value \
+  --worker_number 8 --round 5 --epoch 1 --learning_rate 0.1 \
+  --round_trunc_threshold 0.01 --log_level INFO

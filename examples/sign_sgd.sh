@@ -1,0 +1,11 @@
+#!/bin/bash
+# SignSGD majority vote (reference simulator.sh:2 variant): per-optimizer-step
+# sign-compressed all-reduce — 1-bit uplink, elementwise majority vote,
+# manual SGD apply. Requires the SGD optimizer. Note the small learning
+# rate: every step moves every parameter by exactly +/-lr, so SignSGD wants
+# lr ~10x below plain SGD's (0.001 here reaches ~0.97 in 5 rounds).
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name mnist --model_name lenet5 \
+  --distributed_algorithm sign_SGD \
+  --worker_number 4 --round 5 --epoch 1 --learning_rate 0.001 \
+  --log_level INFO

@@ -24,6 +24,16 @@ def test_mesh_construction():
     assert mesh.axis_names == ("clients",)
 
 
+def test_mesh_shortfall_fails_fast_without_optin(monkeypatch):
+    """Requesting more devices than visible must raise unless the CPU
+    fallback is explicitly opted into (production misconfig guard)."""
+    import pytest
+
+    monkeypatch.delenv("DLS_ALLOW_CPU_MESH_FALLBACK", raising=False)
+    with pytest.raises(ValueError, match="DLS_ALLOW_CPU_MESH_FALLBACK"):
+        make_mesh(len(jax.devices()) + 1)
+
+
 def test_shard_client_data_placement():
     mesh = make_mesh(8)
     x = np.zeros((16, 4), np.float32)
