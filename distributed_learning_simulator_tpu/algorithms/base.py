@@ -85,6 +85,14 @@ class Algorithm:
             jax.numpy.arange(n_clients)
         )
 
+    def make_server_update(self):
+        """Optional server-side optimizer: ``(init_fn, update_fn)`` or None.
+
+        See FedAvg.make_server_update (FedOpt family). None (the default)
+        means the round aggregate becomes the next global model unchanged.
+        """
+        return None
+
     # ---- host side ---------------------------------------------------------
     def prepare(self, apply_fn, eval_fn) -> None:
         """One-time setup after the engine is built (e.g. jit subset-eval)."""

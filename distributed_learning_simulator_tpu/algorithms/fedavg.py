@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from distributed_learning_simulator_tpu.algorithms.base import Algorithm
 from distributed_learning_simulator_tpu.ops.aggregate import weighted_mean
@@ -204,3 +205,39 @@ class FedAvg(Algorithm):
     def client_param_transform(self):
         """Param transform inside the client loss (QAT hook; None here)."""
         return None
+
+    # ---- server optimizer (FedOpt family; exceeds the reference) ----------
+    def make_server_update(self):
+        """Optional server-side optimizer step applied to the round aggregate.
+
+        Returns ``(init_fn, update_fn)`` or ``None`` (plain FedAvg — the
+        reference's fixed behavior, fed_server.py:81-84, where the aggregate
+        becomes the next global model directly). With a server optimizer the
+        pseudo-gradient ``prev_global - aggregate`` is fed to optax:
+        sgd+momentum = FedAvgM, adam = FedAdam (Reddi et al., "Adaptive
+        Federated Optimization"). sgd(lr=1, momentum=0) reduces exactly to
+        FedAvg: ``prev - 1.0 * (prev - agg) = agg``.
+        """
+        cfg = self.config
+        name = cfg.server_optimizer_name.lower()
+        if name in ("none", ""):
+            return None
+        if name == "sgd":
+            tx = optax.sgd(
+                cfg.server_learning_rate, momentum=cfg.server_momentum or None
+            )
+        elif name == "adam":
+            tx = optax.adam(cfg.server_learning_rate)
+        else:  # pre-validated in ExperimentConfig.validate
+            raise ValueError(
+                f"unknown server optimizer {name!r}; known: none, sgd, adam"
+            )
+
+        def update(prev_global, aggregate, opt_state):
+            pseudo_grad = jax.tree_util.tree_map(
+                lambda p, a: (p - a.astype(p.dtype)), prev_global, aggregate
+            )
+            updates, opt_state = tx.update(pseudo_grad, opt_state, prev_global)
+            return optax.apply_updates(prev_global, updates), opt_state
+
+        return tx.init, update

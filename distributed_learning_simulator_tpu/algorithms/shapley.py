@@ -100,6 +100,27 @@ class _SubsetEvaluator:
         return np.concatenate(out)
 
 
+def _check_shapley_config(config) -> None:
+    """Shared preconditions for both Shapley servers.
+
+    Subset utilities are plain weighted means of client params, so every
+    client must participate and no server optimizer may reshape the global
+    model (else the grand coalition's utility disagrees with the round
+    metric and the Shapley values are silently wrong).
+    """
+    if getattr(config, "participation_fraction", 1.0) < 1.0:
+        raise ValueError(
+            "Shapley scoring needs every client's update each round; "
+            "participation_fraction < 1 is not supported"
+        )
+    server_opt = getattr(config, "server_optimizer_name", "none") or "none"
+    if server_opt.lower() not in ("none", ""):
+        raise ValueError(
+            "Shapley scoring assumes plain FedAvg aggregation; set "
+            "server_optimizer_name='none'"
+        )
+
+
 class MultiRoundShapley(FedAvg):
     """Exact multi-round Shapley: full-powerset utility per round.
 
@@ -114,11 +135,7 @@ class MultiRoundShapley(FedAvg):
 
     def __init__(self, config):
         super().__init__(config)
-        if getattr(config, "participation_fraction", 1.0) < 1.0:
-            raise ValueError(
-                "Shapley scoring needs every client's update each round; "
-                "participation_fraction < 1 is not supported"
-            )
+        _check_shapley_config(config)
         self.shapley_values: dict[int, dict[int, float]] = {}
         self._evaluator = None
 
@@ -191,11 +208,7 @@ class GTGShapley(FedAvg):
 
     def __init__(self, config):
         super().__init__(config)
-        if getattr(config, "participation_fraction", 1.0) < 1.0:
-            raise ValueError(
-                "Shapley scoring needs every client's update each round; "
-                "participation_fraction < 1 is not supported"
-            )
+        _check_shapley_config(config)
         self.shapley_values: dict[int, dict[int, float]] = {}
         self._evaluator = None
         self.eps = getattr(config, "gtg_eps", 1e-3)

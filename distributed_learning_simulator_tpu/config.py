@@ -38,6 +38,15 @@ class ExperimentConfig:
     nesterov: bool = False
     seed: int = 0
     reset_client_optimizer: bool = True
+    # --- server optimizer (FedOpt family; exceeds the reference) -----------
+    # "none" = plain FedAvg (the reference's fixed behavior: the aggregate IS
+    # the new global model). "sgd"/"adam" treat (prev_global - aggregate) as
+    # a pseudo-gradient and apply a server-side optimizer step: FedAvgM with
+    # sgd+momentum, FedAdam with adam (Reddi et al., "Adaptive Federated
+    # Optimization"). sgd with lr=1.0 and momentum=0 is exactly FedAvg.
+    server_optimizer_name: str = "none"
+    server_learning_rate: float = 1.0
+    server_momentum: float = 0.0
 
     # --- data partitioning (data/partition.py) -----------------------------
     partition: str = "iid"  # iid | dirichlet
@@ -102,6 +111,21 @@ class ExperimentConfig:
             raise ValueError(f"unknown partition {self.partition!r}")
         if not 0.0 < self.participation_fraction <= 1.0:
             raise ValueError("participation_fraction must be in (0, 1]")
+        server_opt = self.server_optimizer_name.lower()
+        if server_opt not in ("none", "", "sgd", "adam"):
+            raise ValueError(
+                f"unknown server optimizer {self.server_optimizer_name!r}; "
+                "known: none, sgd, adam"
+            )
+        if self.server_learning_rate <= 0.0:
+            raise ValueError("server_learning_rate must be > 0")
+        if not 0.0 <= self.server_momentum < 1.0:
+            raise ValueError("server_momentum must be in [0, 1)")
+        if server_opt == "adam" and self.server_momentum:
+            raise ValueError(
+                "server_momentum is only used by the sgd server optimizer; "
+                "adam ignores it — unset one of the two"
+            )
         return self
 
 

@@ -172,6 +172,11 @@ def run_threaded_simulation(
         raise ValueError(
             "threaded execution mode currently supports algorithm 'fed'"
         )
+    if config.server_optimizer_name.lower() not in ("none", ""):
+        raise ValueError(
+            "threaded execution mode does not support server optimizers; "
+            "use run_simulation for FedAvgM/FedAdam"
+        )
     if dataset is None:
         dataset = get_dataset(
             config.dataset_name, data_dir=config.data_dir, seed=config.seed,

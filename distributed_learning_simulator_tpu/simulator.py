@@ -157,6 +157,28 @@ def run_simulation(
     )
     round_jit = jax.jit(round_fn, donate_argnums=(1,))
 
+    # Optional server-side optimizer (FedOpt; exceeds the reference): the
+    # aggregate is post-processed by a jitted pseudo-gradient step.
+    server_state = None
+    server_update_jit = None
+    _server = algorithm.make_server_update()
+    if (
+        _server is None
+        and config.server_optimizer_name.lower() not in ("none", "")
+    ):
+        # Don't let a configured server optimizer silently no-op: only the
+        # FedAvg family consumes it (SignSGD applies votes inside the round).
+        raise ValueError(
+            f"algorithm {config.distributed_algorithm!r} does not support a "
+            "server optimizer; set server_optimizer_name='none'"
+        )
+    if _server is not None:
+        server_init, server_update_fn = _server
+        server_state = server_init(global_params)
+        # Donate the consumed aggregate and the replaced opt state: neither
+        # is referenced after the call (entry keeps only the updated state).
+        server_update_jit = jax.jit(server_update_fn, donate_argnums=(1, 2))
+
     # --- resume (before placement, so restored state gets sharded too) ------
     start_round = 0
     prev_metrics: dict | None = None
@@ -176,6 +198,34 @@ def run_simulation(
             )
             start_round = ckpt["round_idx"] + 1
             prev_metrics = ckpt["algo_state"].get("prev_metrics")
+            if (
+                server_state is None
+                and ckpt["algo_state"].get("server_opt_state") is not None
+            ):
+                raise ValueError(
+                    "checkpoint was written with a server optimizer but "
+                    "server_optimizer_name='none' now; resume with the "
+                    "configuration the checkpoint was written with"
+                )
+            if server_state is not None:
+                saved_ss = ckpt["algo_state"].get("server_opt_state")
+                if saved_ss is None:
+                    logger.warning(
+                        "checkpoint has no server optimizer state (written "
+                        "before the feature or with a different config); "
+                        "server optimizer restarts from fresh state"
+                    )
+                else:
+                    want = jax.tree_util.tree_structure(server_state)
+                    got = jax.tree_util.tree_structure(saved_ss)
+                    if want != got:
+                        raise ValueError(
+                            "checkpoint server optimizer state does not match "
+                            f"server_optimizer_name="
+                            f"{config.server_optimizer_name!r}; resume with "
+                            "the configuration the checkpoint was written with"
+                        )
+                    server_state = jax.tree_util.tree_map(jnp.asarray, saved_ss)
             if ckpt.get("rng_key") is not None:
                 key = ckpt["rng_key"]
             if hasattr(algorithm, "shapley_values"):
@@ -202,6 +252,8 @@ def run_simulation(
         data_arrays = shard_client_data(data_arrays, mesh)
         client_state = shard_client_data(client_state, mesh)
         global_params = replicate(global_params, mesh)
+        if server_state is not None:
+            server_state = replicate(server_state, mesh)
         sizes = replicate(sizes, mesh)
         eval_batches = replicate(eval_batches, mesh)
         logger.info("client axis sharded over %d devices", config.mesh_devices)
@@ -219,13 +271,17 @@ def run_simulation(
     # the chip sits behind a network tunnel) overlaps device compute. Results
     # are bit-identical to the synchronous path — only fetch timing moves.
     # Not used when post_round must see metrics in the same round (Shapley),
-    # nor when per-client state is checkpointed (the state buffer for round
-    # r is donated to round r+1's dispatch before r's checkpoint would run).
+    # nor when checkpointing needs per-client or server-optimizer state (those
+    # buffers are donated to round r+1's dispatch before round r's deferred
+    # checkpoint would read them).
     checkpointing = bool(config.checkpoint_dir and config.checkpoint_every)
     pipelined = (
         config.pipeline_rounds
         and algorithm.supports_round_pipelining
-        and not (checkpointing and client_state is not None)
+        and not (
+            checkpointing
+            and (client_state is not None or server_state is not None)
+        )
     )
     t_start = time.perf_counter()
     t_prev_done = t_start
@@ -284,6 +340,10 @@ def run_simulation(
             algo_state = {"prev_metrics": metrics}
             if hasattr(algorithm, "shapley_values"):
                 algo_state["shapley_values"] = algorithm.shapley_values
+            if p["server_state"] is not None:
+                algo_state["server_opt_state"] = jax.device_get(
+                    p["server_state"]
+                )
             save_checkpoint(
                 os.path.join(
                     config.checkpoint_dir, f"round_{p['round_idx']}.ckpt"
@@ -304,6 +364,10 @@ def run_simulation(
                         global_params, client_state, cx, cy, cmask, sizes,
                         round_key,
                     )
+                    if server_update_jit is not None:
+                        new_global, server_state = server_update_jit(
+                            global_params, new_global, server_state
+                        )
                 with annotate("server_eval"):
                     metrics_dev = evaluate(new_global, *eval_batches)
                 entry = {
@@ -315,6 +379,7 @@ def run_simulation(
                     "metrics_dev": metrics_dev,
                     "mean_loss_dev": aux.get("mean_client_loss", np.nan),
                     "key": key,
+                    "server_state": server_state,
                 }
                 global_params = new_global
                 if pipelined:

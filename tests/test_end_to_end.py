@@ -54,6 +54,32 @@ def test_cnn_tpu_learns(tiny_config):
     assert all(np.isfinite(losses))
 
 
+def test_server_sgd_lr1_equals_plain_fedavg(tiny_config):
+    """FedOpt sanity: server sgd(lr=1, momentum=0) applies
+    prev - 1.0*(prev - aggregate) = aggregate, i.e. exactly plain FedAvg."""
+    r1 = _run(tiny_config, round=3)
+    r2 = _run(tiny_config, round=3, server_optimizer_name="sgd",
+              server_learning_rate=1.0, server_momentum=0.0)
+    a1 = [h["test_accuracy"] for h in r1["history"]]
+    a2 = [h["test_accuracy"] for h in r2["history"]]
+    np.testing.assert_allclose(a1, a2, atol=1e-6)
+
+
+def test_server_momentum_learns_and_differs(tiny_config):
+    """FedAvgM (server momentum) trains and actually changes the trajectory."""
+    plain = _run(tiny_config, round=4)
+    fedavgm = _run(tiny_config, round=4, server_optimizer_name="sgd",
+                   server_learning_rate=1.0, server_momentum=0.9)
+    accs = [h["test_accuracy"] for h in fedavgm["history"]]
+    assert accs[-1] > 0.2  # learns
+    assert accs != [h["test_accuracy"] for h in plain["history"]]
+
+
+def test_unknown_server_optimizer_raises(tiny_config):
+    with pytest.raises(ValueError, match="server optimizer"):
+        _run(tiny_config, round=1, server_optimizer_name="bogus")
+
+
 def test_fedavg_deterministic(tiny_config):
     r1 = _run(tiny_config)
     r2 = _run(tiny_config)
