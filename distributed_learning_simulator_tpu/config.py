@@ -93,6 +93,11 @@ class ExperimentConfig:
     pipeline_rounds: bool = True
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
+    # Persistent XLA compilation cache directory: the round program's
+    # ~20-45s first compile is skipped on any later run with the same
+    # shapes (including across processes). Disable with None, or from the
+    # CLI with --compilation_cache_dir none (normalized in validate()).
+    compilation_cache_dir: str | None = ".jax_cache"
     # Store packed client shards as uint8-flattened arrays (4x less HBM,
     # TPU-friendly tiling); batches are decoded on the fly in the step.
     compact_client_data: bool = True
@@ -111,6 +116,8 @@ class ExperimentConfig:
             raise ValueError(f"unknown partition {self.partition!r}")
         if not 0.0 < self.participation_fraction <= 1.0:
             raise ValueError("participation_fraction must be in (0, 1]")
+        if self.compilation_cache_dir in ("", "none", "None"):
+            self.compilation_cache_dir = None
         server_opt = self.server_optimizer_name.lower()
         if server_opt not in ("none", "", "sgd", "adam"):
             raise ValueError(

@@ -108,6 +108,16 @@ def run_simulation(
     config.validate()
     logger = get_logger()
     set_level(config.log_level)
+    if config.compilation_cache_dir:
+        jax.config.update(
+            "jax_compilation_cache_dir", config.compilation_cache_dir
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    else:
+        # The setting is process-global; reset so a cache enabled by an
+        # earlier run in this process doesn't leak into a run that asked
+        # for no caching.
+        jax.config.update("jax_compilation_cache_dir", None)
     log_dir = None
     if setup_logging:
         log_path = set_file_handler(
