@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,54 @@ from distributed_learning_simulator_tpu.utils.tracing import (
     annotate,
     profile_session,
 )
+
+
+@contextmanager
+def _oom_hint(config, global_params, n_clients: int):
+    """Re-raise device OOMs with an actionable client_chunk_size suggestion.
+
+    Wraps every point where an async-dispatched round can surface a
+    RESOURCE_EXHAUSTED error (dispatch, eval, and the deferred metric fetch
+    — with async dispatch an execution-time OOM appears at the next host
+    sync, not necessarily at the call that caused it).
+
+    Footprint model (measured on v5e): ~4x the f32 param bytes per
+    in-flight client (grads + momentum + conv weight-grad temps, incl.
+    fragmentation); budget 60% of per-device HBM times the mesh size (the
+    chunk is split across mesh devices); 16 GB fallback when the plugin
+    doesn't report memory stats.
+    """
+    try:
+        yield
+    except jax.errors.JaxRuntimeError as e:
+        if "out of memory" not in str(e).lower():
+            raise
+        current = config.client_chunk_size or n_clients
+        param_bytes = sum(
+            leaf.size * 4 for leaf in jax.tree_util.tree_leaves(global_params)
+        )
+        hbm = 16 * 1024**3
+        try:
+            stats = jax.devices()[0].memory_stats()
+            hbm = stats.get("bytes_limit", hbm) or hbm
+        except Exception:
+            pass
+        n_mesh = config.mesh_devices or 1
+        estimate = max(1, int(0.6 * hbm * n_mesh / (4 * param_bytes)))
+        suggestion = min(estimate, max(1, current // 2))
+        if suggestion >= current:
+            raise RuntimeError(
+                "round program exceeded device memory even with "
+                f"client_chunk_size={current}; the model "
+                f"(~{param_bytes / 2**20:.0f} MB of params) may not fit this "
+                "device — use a smaller model or more mesh devices."
+            ) from e
+        raise RuntimeError(
+            "round program exceeded device memory with "
+            f"{current} clients in flight (per-client params/grads/momentum "
+            "and activations scale with client_chunk_size). Try "
+            f"client_chunk_size={suggestion}."
+        ) from e
 
 
 def build_client_data(config: ExperimentConfig, dataset: Dataset) -> ClientData:
@@ -299,9 +348,10 @@ def run_simulation(
 
     def finalize(p: dict) -> None:
         nonlocal prev_metrics, t_prev_done
-        fetched_metrics, fetched_loss = jax.device_get(
-            (p["metrics_dev"], p["mean_loss_dev"])
-        )
+        with _oom_hint(config, p["new_global"], n_clients):
+            fetched_metrics, fetched_loss = jax.device_get(
+                (p["metrics_dev"], p["mean_loss_dev"])
+            )
         metrics = {k: float(v) for k, v in fetched_metrics.items()}
         ctx = RoundContext(
             round_idx=p["round_idx"],
@@ -369,7 +419,9 @@ def run_simulation(
         try:
             for round_idx in range(start_round, config.round):
                 key, round_key = jax.random.split(key)
-                with annotate(f"fl_round_{round_idx}"):
+                with annotate(f"fl_round_{round_idx}"), _oom_hint(
+                    config, global_params, n_clients
+                ):
                     new_global, client_state, aux = round_jit(
                         global_params, client_state, cx, cy, cmask, sizes,
                         round_key,
@@ -378,7 +430,9 @@ def run_simulation(
                         new_global, server_state = server_update_jit(
                             global_params, new_global, server_state
                         )
-                with annotate("server_eval"):
+                with annotate("server_eval"), _oom_hint(
+                    config, global_params, n_clients
+                ):
                     metrics_dev = evaluate(new_global, *eval_batches)
                 entry = {
                     "round_idx": round_idx,
