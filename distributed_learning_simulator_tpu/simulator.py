@@ -68,7 +68,7 @@ from distributed_learning_simulator_tpu.utils.tracing import (
 
 
 @contextmanager
-def _oom_hint(config, global_params, n_clients: int):
+def _oom_hint(config, global_params, n_clients: int, site: str = "round"):
     """Re-raise device OOMs with an actionable client_chunk_size suggestion.
 
     Wraps every point where an async-dispatched round can surface a
@@ -87,7 +87,15 @@ def _oom_hint(config, global_params, n_clients: int):
     except jax.errors.JaxRuntimeError as e:
         if "out of memory" not in str(e).lower():
             raise
-        current = config.client_chunk_size or n_clients
+        # In-flight clients = chunk bounded by the sampled cohort size.
+        cohort = max(1, round(config.participation_fraction * n_clients))
+        current = min(config.client_chunk_size or cohort, cohort)
+        eval_note = (
+            f" This OOM surfaced at {site}: if lowering client_chunk_size "
+            f"doesn't help, also lower eval_batch_size "
+            f"(currently {config.eval_batch_size})."
+            if site != "round" else ""
+        )
         param_bytes = sum(
             leaf.size * 4 for leaf in jax.tree_util.tree_leaves(global_params)
         )
@@ -102,16 +110,17 @@ def _oom_hint(config, global_params, n_clients: int):
         suggestion = min(estimate, max(1, current // 2))
         if suggestion >= current:
             raise RuntimeError(
-                "round program exceeded device memory even with "
+                "device memory exceeded even with "
                 f"client_chunk_size={current}; the model "
                 f"(~{param_bytes / 2**20:.0f} MB of params) may not fit this "
                 "device — use a smaller model or more mesh devices."
+                + eval_note
             ) from e
         raise RuntimeError(
-            "round program exceeded device memory with "
+            "device memory exceeded with "
             f"{current} clients in flight (per-client params/grads/momentum "
             "and activations scale with client_chunk_size). Try "
-            f"client_chunk_size={suggestion}."
+            f"client_chunk_size={suggestion}." + eval_note
         ) from e
 
 
@@ -348,7 +357,8 @@ def run_simulation(
 
     def finalize(p: dict) -> None:
         nonlocal prev_metrics, t_prev_done
-        with _oom_hint(config, p["new_global"], n_clients):
+        with _oom_hint(config, p["new_global"], n_clients,
+                       site="deferred metric fetch"):
             fetched_metrics, fetched_loss = jax.device_get(
                 (p["metrics_dev"], p["mean_loss_dev"])
             )
@@ -431,7 +441,7 @@ def run_simulation(
                             global_params, new_global, server_state
                         )
                 with annotate("server_eval"), _oom_hint(
-                    config, global_params, n_clients
+                    config, global_params, n_clients, site="eval"
                 ):
                     metrics_dev = evaluate(new_global, *eval_batches)
                 entry = {

@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Download MNIST / CIFAR-10 and save them in the registry's .npz layout.
+
+Usage (needs network; the training container is offline and falls back to
+the synthetic surrogate instead):
+
+    python scripts/fetch_datasets.py [--data_dir /root/data] [mnist cifar10]
+
+Writes ``<data_dir>/<name>.npz`` with keys x_train/y_train/x_test/y_test —
+exactly what ``data/registry.py`` looks for before falling back. Images are
+stored uint8; the registry rescales to [0, 1] on load.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_learning_simulator_tpu.data.formats import (  # noqa: E402
+    cifar10_arrays,
+    mnist_arrays,
+)
+
+MNIST_BASE = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+MNIST_FILES = [
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+]
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+
+
+def _get(url: str) -> bytes:
+    print(f"  downloading {url}")
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return r.read()
+
+
+def fetch_mnist(data_dir: str) -> str:
+    arrays = mnist_arrays(*(_get(MNIST_BASE + f) for f in MNIST_FILES))
+    path = os.path.join(data_dir, "mnist.npz")
+    np.savez_compressed(path, **arrays)
+    return path
+
+
+def fetch_cifar10(data_dir: str) -> str:
+    arrays = cifar10_arrays(_get(CIFAR10_URL))
+    path = os.path.join(data_dir, "cifar10.npz")
+    np.savez_compressed(path, **arrays)
+    return path
+
+
+FETCHERS = {"mnist": fetch_mnist, "cifar10": fetch_cifar10}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("names", nargs="*",
+                    help="datasets to fetch (default: all of "
+                    f"{sorted(FETCHERS)})")
+    ap.add_argument("--data_dir",
+                    default=os.environ.get("DLS_DATA_DIR", "/root/data"))
+    args = ap.parse_args()
+    names = args.names or sorted(FETCHERS)
+    unknown = sorted(set(names) - set(FETCHERS))
+    if unknown:
+        ap.error(f"unknown dataset(s) {unknown}; known: {sorted(FETCHERS)}")
+    os.makedirs(args.data_dir, exist_ok=True)
+    for name in names:
+        print(f"fetching {name} ...")
+        path = FETCHERS[name](args.data_dir)
+        with np.load(path) as z:
+            shapes = {k: z[k].shape for k in z.files}
+        print(f"  wrote {path}: {shapes}")
+
+
+if __name__ == "__main__":
+    main()
