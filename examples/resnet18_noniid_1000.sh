@@ -1,0 +1,13 @@
+#!/bin/bash
+# Scale config BASELINE.json configs[4]: 1000 clients, non-IID
+# Dirichlet(alpha=0.1), ResNet-18 (GroupNorm, bf16). Shards are padded to
+# --max_shard_size with 0/1 masks (empty clients get zero aggregation
+# weight), and --client_chunk_size 50 bounds the per-chunk HBM footprint
+# (~3.3 s/round on one chip; 200 OOMs — see docs/PERFORMANCE.md).
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name cifar10 --model_name resnet18 \
+  --distributed_algorithm fed \
+  --worker_number 1000 --round 20 --epoch 1 --learning_rate 0.1 \
+  --momentum 0.9 --batch_size 25 \
+  --partition dirichlet --dirichlet_alpha 0.1 --max_shard_size 100 \
+  --client_chunk_size 50 --eval_batch_size 10000 --log_level INFO
