@@ -3,7 +3,8 @@
 # Dirichlet(alpha=0.1), ResNet-18 (GroupNorm, bf16). Shards are padded to
 # --max_shard_size with 0/1 masks (empty clients get zero aggregation
 # weight), and --client_chunk_size 50 bounds the per-chunk HBM footprint
-# (~3.3 s/round on one chip; 200 OOMs — see docs/PERFORMANCE.md).
+# (~6.3 s/round on one chip at shard cap 100 — every client scans
+# cap/batch_size steps; chunk 200 OOMs — see docs/PERFORMANCE.md).
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name cifar10 --model_name resnet18 \
   --distributed_algorithm fed \
