@@ -39,6 +39,8 @@ class FedAvg(Algorithm):
 
     def make_round_fn(self, apply_fn, optimizer, n_clients: int,
                       preprocess=None):
+        from distributed_learning_simulator_tpu.ops.augment import get_augment
+
         cfg = self.config
         local_train = make_local_train_fn(
             apply_fn,
@@ -48,6 +50,7 @@ class FedAvg(Algorithm):
             param_transform=self.client_param_transform(),
             reset_optimizer=cfg.reset_client_optimizer,
             preprocess=preprocess,
+            augment=get_augment(cfg.augment),
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
         keep = self.keep_client_params

@@ -43,6 +43,13 @@ class SignSGD(Algorithm):
                 "sign_SGD requires the SGD optimizer "
                 "(parity with reference sign_sgd_worker.py:14)"
             )
+        if getattr(config, "augment", "none").lower() not in ("none", ""):
+            # sign_SGD builds its own per-step sync loop that doesn't plumb
+            # augmentation; reject rather than silently train un-augmented.
+            raise ValueError(
+                "sign_SGD does not support data augmentation; set "
+                "augment='none'"
+            )
 
     def init_client_state(self, optimizer, global_params, n_clients):
         """Per-client momentum buffers + step counters (reference replicates

@@ -38,6 +38,11 @@ class ExperimentConfig:
     nesterov: bool = False
     seed: int = 0
     reset_client_optimizer: bool = True
+    # In-step data augmentation (ops/augment.py): "none" or "cifar"
+    # (random flip + pad-4 random crop). Replaces the reference's external
+    # dataset-transform hook (transform_dataset, SURVEY §2.4) with a pure
+    # batched op fused into the round program. FedAvg-family only.
+    augment: str = "none"
     # --- server optimizer (FedOpt family; exceeds the reference) -----------
     # "none" = plain FedAvg (the reference's fixed behavior: the aggregate IS
     # the new global model). "sgd"/"adam" treat (prev_global - aggregate) as
@@ -118,6 +123,9 @@ class ExperimentConfig:
             raise ValueError("participation_fraction must be in (0, 1]")
         if self.compilation_cache_dir in ("", "none", "None"):
             self.compilation_cache_dir = None
+        from distributed_learning_simulator_tpu.ops.augment import get_augment
+
+        get_augment(self.augment)  # fail fast on unknown augmentation names
         server_opt = self.server_optimizer_name.lower()
         if server_opt not in ("none", "", "sgd", "adam"):
             raise ValueError(

@@ -192,6 +192,8 @@ def run_threaded_simulation(
         config.optimizer_name, config.learning_rate,
         momentum=config.momentum, weight_decay=config.weight_decay,
     )
+    from distributed_learning_simulator_tpu.ops.augment import get_augment
+
     local_train = jax.jit(
         make_local_train_fn(
             model.apply, optimizer, local_epochs=config.epoch,
@@ -200,6 +202,7 @@ def run_threaded_simulation(
                 make_decoder(client_data.sample_shape)
                 if client_data.compact else None
             ),
+            augment=get_augment(config.augment),
         )
     )
     evaluate = jax.jit(make_eval_fn(model.apply))

@@ -17,7 +17,7 @@ from distributed_learning_simulator_tpu.models.cnn import (
     TpuCifarCNN,
 )
 from distributed_learning_simulator_tpu.models.lenet import LeNet5
-from distributed_learning_simulator_tpu.models.resnet import ResNet18
+from distributed_learning_simulator_tpu.models.resnet import ResNet18, ResNet34
 
 _MODELS = {
     "lenet5": LeNet5,
@@ -26,6 +26,7 @@ _MODELS = {
     "cnntpu": TpuCifarCNN,
     "tpucnn": TpuCifarCNN,
     "resnet18": ResNet18,
+    "resnet34": ResNet34,
     "mlp": MLP,
 }
 

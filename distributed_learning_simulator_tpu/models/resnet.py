@@ -47,6 +47,8 @@ class ResidualBlock(nn.Module):
 
 
 class ResNet18(nn.Module):
+    """Generic basic-block ResNet; default stage sizes give ResNet-18."""
+
     num_classes: int = 10
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
     width: int = 64
@@ -68,3 +70,9 @@ class ResNet18(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x.astype(jnp.float32)
+
+
+def ResNet34(num_classes: int = 10, **kwargs):
+    """ResNet-34 stage configuration of the same basic-block network."""
+    kwargs.setdefault("stage_sizes", (3, 4, 6, 3))
+    return ResNet18(num_classes=num_classes, **kwargs)

@@ -1,0 +1,55 @@
+"""In-step data augmentation, fixed-shape and jit-friendly.
+
+The reference delegates dataset transforms to its external trainer
+(``dataset_collection.transform_dataset``, reference simulator.py:20-22 —
+the L1 surface in SURVEY §2.4). Here augmentation is a pure batched op
+applied inside the training step after shard decode, so it fuses into the
+round program: fresh randomness every step, zero host involvement, no
+recompilation (shapes never change).
+
+``cifar_augment``: the standard CIFAR recipe — random horizontal flip +
+pad-4 random crop, vectorized over the batch with per-sample offsets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_PAD = 4
+
+
+def cifar_augment(x: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """Random flip + pad-4 random crop on an NHWC batch, per-sample RNG.
+
+    Padding rows/cols are zeros (the dataset's [0, 1] range makes zero the
+    natural fill). Returns the same shape and dtype as the input.
+    """
+    b, h, w, c = x.shape
+    flip_key, crop_key = jax.random.split(key)
+
+    flip = jax.random.bernoulli(flip_key, 0.5, (b,))
+    x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+
+    pad = jnp.pad(x, ((0, 0), (_PAD, _PAD), (_PAD, _PAD), (0, 0)))
+    offsets = jax.random.randint(crop_key, (b, 2), 0, 2 * _PAD + 1)
+
+    def crop_one(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+
+    return jax.vmap(crop_one)(pad, offsets)
+
+
+_AUGMENTS = {"cifar": cifar_augment}
+
+
+def get_augment(name: str | None):
+    """Augment registry: name -> fn(batch, key) -> batch; 'none'/None -> None."""
+    if not name or name.lower() in ("none", ""):
+        return None
+    key = name.lower()
+    if key not in _AUGMENTS:
+        raise ValueError(
+            f"unknown augmentation {name!r}; known: none, {sorted(_AUGMENTS)}"
+        )
+    return _AUGMENTS[key]

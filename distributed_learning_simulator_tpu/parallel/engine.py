@@ -88,6 +88,7 @@ def make_local_train_fn(
     param_transform: Callable | None = None,
     reset_optimizer: bool = True,
     preprocess: Callable | None = None,
+    augment: Callable | None = None,
 ):
     """Build ``local_train(params, opt_state, xs, ys, mask, key)``.
 
@@ -107,6 +108,11 @@ def make_local_train_fn(
     def local_train(params, opt_state, xs, ys, mask, key):
         shard_size = xs.shape[0]
         steps_per_epoch = shard_size // batch_size
+        aug_key = None
+        if augment is not None:
+            # Split only when augmenting so the un-augmented RNG stream
+            # (shuffles) is unchanged by this feature.
+            key, aug_key = jax.random.split(key)
         if reset_optimizer:
             # Fresh optimizer every round (standard FedAvg). The incoming
             # opt_state is ignored and None is returned in its place — at
@@ -114,7 +120,8 @@ def make_local_train_fn(
             # be dead weight the size of the whole model per client.
             opt_state = optimizer.init(params)
 
-        def epoch_body(carry, epoch_key):
+        def epoch_body(carry, scan_in):
+            epoch_key, epoch_idx = scan_in
             params, opt_state = carry
             perm = jax.random.permutation(epoch_key, shard_size)
 
@@ -128,6 +135,13 @@ def make_local_train_fn(
                 bm = jnp.take(mask, idx, axis=0)
                 if preprocess is not None:
                     bx = preprocess(bx)
+                if augment is not None:
+                    # Fresh per-(epoch, step) augmentation randomness,
+                    # independent of the shuffle keys.
+                    bx = augment(
+                        bx, jax.random.fold_in(jax.random.fold_in(
+                            aug_key, epoch_idx), step),
+                    )
                 (loss, acc), grads = grad_fn(params, bx, by, bm)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
@@ -140,7 +154,8 @@ def make_local_train_fn(
 
         epoch_keys = jax.random.split(key, local_epochs)
         (params, opt_state), (epoch_losses, epoch_accs) = jax.lax.scan(
-            epoch_body, (params, opt_state), epoch_keys
+            epoch_body, (params, opt_state),
+            (epoch_keys, jnp.arange(local_epochs)),
         )
         metrics = {"loss": epoch_losses[-1], "accuracy": epoch_accs[-1]}
         return params, (None if reset_optimizer else opt_state), metrics
