@@ -19,7 +19,11 @@ import jax.numpy as jnp
 import optax
 
 from distributed_learning_simulator_tpu.algorithms.base import Algorithm
-from distributed_learning_simulator_tpu.ops.aggregate import weighted_mean
+from distributed_learning_simulator_tpu.ops.aggregate import (
+    coordinate_median,
+    trimmed_mean,
+    weighted_mean,
+)
 from distributed_learning_simulator_tpu.parallel.engine import make_local_train_fn
 
 
@@ -54,6 +58,10 @@ class FedAvg(Algorithm):
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
         keep = self.keep_client_params
+        aggregation = cfg.aggregation.lower()
+        # Robust rules need every client's params at once (a median has no
+        # chunkwise partial sum), so they share the materializing path.
+        materialize = keep or aggregation != "mean"
         chunk = cfg.client_chunk_size
         frac = cfg.participation_fraction
         n_participants = (
@@ -161,17 +169,23 @@ class FedAvg(Algorithm):
             norm_w = part_sizes / jnp.maximum(total_size, 1e-12)
 
             aux = {}
-            if keep:
+            if materialize:
                 client_params, new_state_k, train_metrics = train_clients(
                     global_params, state_k, x_k, y_k, m_k, client_keys
                 )
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )
-                new_global = weighted_mean(client_params, part_sizes)
-                aux["client_params"] = client_params
-                if idx is not None:
-                    aux["participants"] = idx
+                if aggregation == "median":
+                    new_global = coordinate_median(client_params)
+                elif aggregation == "trimmed_mean":
+                    new_global = trimmed_mean(client_params, cfg.trim_ratio)
+                else:
+                    new_global = weighted_mean(client_params, part_sizes)
+                if keep:
+                    aux["client_params"] = client_params
+                    if idx is not None:
+                        aux["participants"] = idx
             else:
                 new_global, new_state_k, train_metrics = train_and_reduce(
                     global_params, state_k, x_k, y_k, m_k, client_keys,

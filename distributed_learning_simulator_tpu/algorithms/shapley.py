@@ -126,6 +126,11 @@ def _check_shapley_config(config) -> None:
             "Shapley scoring assumes plain FedAvg aggregation; set "
             "server_optimizer_name='none'"
         )
+    if getattr(config, "aggregation", "mean").lower() != "mean":
+        raise ValueError(
+            "Shapley scoring assumes the weighted-mean aggregator (subset "
+            "utilities are weighted means); set aggregation='mean'"
+        )
 
 
 class MultiRoundShapley(FedAvg):

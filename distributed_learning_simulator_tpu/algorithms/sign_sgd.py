@@ -50,6 +50,13 @@ class SignSGD(Algorithm):
                 "sign_SGD does not support data augmentation; set "
                 "augment='none'"
             )
+        if getattr(config, "aggregation", "mean").lower() != "mean":
+            # Aggregation IS the sign majority vote here; a robust-mean
+            # setting would be silently meaningless.
+            raise ValueError(
+                "sign_SGD aggregates by sign majority vote; set "
+                "aggregation='mean'"
+            )
 
     def init_client_state(self, optimizer, global_params, n_clients):
         """Per-client momentum buffers + step counters (reference replicates

@@ -43,6 +43,13 @@ class ExperimentConfig:
     # dataset-transform hook (transform_dataset, SURVEY §2.4) with a pure
     # batched op fused into the round program. FedAvg-family only.
     augment: str = "none"
+    # FedAvg aggregation rule (ops/aggregate.py): "mean" (dataset-size-
+    # weighted, the reference's only rule), or the Byzantine-robust
+    # "median" / "trimmed_mean" (drop trim_ratio of extremes per
+    # coordinate). Robust rules materialize the full per-client parameter
+    # stack, so large models cap the feasible client count.
+    aggregation: str = "mean"
+    trim_ratio: float = 0.1
     # --- server optimizer (FedOpt family; exceeds the reference) -----------
     # "none" = plain FedAvg (the reference's fixed behavior: the aggregate IS
     # the new global model). "sgd"/"adam" treat (prev_global - aggregate) as
@@ -126,6 +133,13 @@ class ExperimentConfig:
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 
         get_augment(self.augment)  # fail fast on unknown augmentation names
+        if self.aggregation.lower() not in ("mean", "median", "trimmed_mean"):
+            raise ValueError(
+                f"unknown aggregation {self.aggregation!r}; known: mean, "
+                "median, trimmed_mean"
+            )
+        if not 0.0 <= self.trim_ratio < 0.5:
+            raise ValueError("trim_ratio must be in [0, 0.5)")
         server_opt = self.server_optimizer_name.lower()
         if server_opt not in ("none", "", "sgd", "adam"):
             raise ValueError(

@@ -66,6 +66,46 @@ def subset_weighted_mean(stacked_tree, weights, mask, fallback_tree):
     return jax.tree_util.tree_map(_leaf, stacked_tree, fallback_tree)
 
 
+def coordinate_median(stacked_tree):
+    """Coordinate-wise median over the client axis (Byzantine-robust).
+
+    Robust-aggregation extension beyond the reference (its weighted mean,
+    fed_server.py:58-66, is the only aggregator there — yet its own
+    heterogeneity experiment injects a poisoned client,
+    simulator_backup.py:71-77). Unweighted by construction: a median has no
+    meaningful per-client weighting. Clients whose local training saw no
+    real samples return the broadcast global params unchanged (masked loss
+    gives zero gradients), which safely biases the median toward the
+    previous model.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jnp.median(x.astype(jnp.float32), axis=0).astype(x.dtype),
+        stacked_tree,
+    )
+
+
+def trimmed_mean(stacked_tree, trim_ratio: float):
+    """Coordinate-wise trimmed mean: drop the k lowest and k highest values
+    per coordinate (k = floor(trim_ratio * n_clients)), average the rest.
+
+    Byzantine-robust for up to k adversarial clients. ``trim_ratio`` is
+    static (part of the compiled program).
+    """
+
+    def _leaf(x):
+        n = x.shape[0]
+        k = int(trim_ratio * n)
+        if 2 * k >= n:
+            raise ValueError(
+                f"trim_ratio {trim_ratio} removes all {n} clients"
+            )
+        s = jnp.sort(x.astype(jnp.float32), axis=0)
+        kept = s[k : n - k] if k else s
+        return jnp.mean(kept, axis=0).astype(x.dtype)
+
+    return jax.tree_util.tree_map(_leaf, stacked_tree)
+
+
 def subset_masks_all(n_clients: int, include_empty: bool = True) -> np.ndarray:
     """All 2^N subset masks as a ``[2^N, N]`` 0/1 array (host-side helper).
 

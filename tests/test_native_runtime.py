@@ -147,6 +147,19 @@ def test_threaded_simulation_learns(tiny_config):
     assert accs[-1] > accs[0] - 0.05
 
 
+def test_threaded_median_aggregation(tiny_config):
+    """The thread-per-client server honors the robust aggregation config."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, round=2, aggregation="median")
+    res = run_threaded_simulation(cfg)
+    import numpy as np
+
+    assert all(np.isfinite(h["test_loss"]) for h in res["history"])
+
+
 def test_threaded_rejects_other_algorithms(tiny_config):
     from distributed_learning_simulator_tpu.execution.threaded import (
         run_threaded_simulation,

@@ -1,0 +1,73 @@
+"""Byzantine-robust aggregation (median / trimmed mean) — ops + end-to-end.
+
+Extension beyond the reference, motivated by its own poisoning experiment
+(reference simulator_backup.py:71-77 swaps worker 0's data): the reference
+can inject a poisoned client but only aggregate with a weighted mean.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.ops.aggregate import (
+    coordinate_median,
+    trimmed_mean,
+    weighted_mean,
+)
+from distributed_learning_simulator_tpu.simulator import run_simulation
+
+
+def _stack_with_outlier():
+    """9 honest clients near 1.0, one adversarial client at 1000."""
+    honest = np.random.default_rng(0).normal(1.0, 0.01, size=(9, 4, 3))
+    evil = np.full((1, 4, 3), 1000.0)
+    return {"w": jnp.asarray(np.concatenate([honest, evil]), jnp.float32)}
+
+
+def test_median_ignores_outlier():
+    stacked = _stack_with_outlier()
+    med = coordinate_median(stacked)["w"]
+    mean = weighted_mean(stacked, np.ones(10))["w"]
+    assert np.abs(np.asarray(med) - 1.0).max() < 0.05
+    assert np.asarray(mean).min() > 50.0  # the mean is wrecked
+
+
+def test_trimmed_mean_ignores_outlier():
+    stacked = _stack_with_outlier()
+    out = trimmed_mean(stacked, 0.1)["w"]  # k=1: drops the outlier
+    assert np.abs(np.asarray(out) - 1.0).max() < 0.05
+
+
+def test_trimmed_mean_matches_numpy():
+    x = np.random.default_rng(1).normal(size=(10, 5)).astype(np.float32)
+    out = np.asarray(trimmed_mean({"w": jnp.asarray(x)}, 0.2)["w"])
+    s = np.sort(x, axis=0)
+    np.testing.assert_allclose(out, s[2:-2].mean(axis=0), rtol=1e-5)
+
+
+def test_trimmed_mean_rejects_full_trim():
+    with pytest.raises(ValueError, match="removes all"):
+        trimmed_mean({"w": jnp.zeros((4, 2))}, 0.5)
+
+
+def test_end_to_end_median(tiny_config):
+    res = run_simulation(
+        dataclasses.replace(tiny_config, round=4, aggregation="median"),
+        setup_logging=False,
+    )
+    accs = [h["test_accuracy"] for h in res["history"]]
+    assert accs[-1] > 0.25  # learns (median of IID clients ~ mean)
+
+
+def test_shapley_rejects_robust_aggregation(tiny_config):
+    with pytest.raises(ValueError, match="aggregation"):
+        run_simulation(
+            dataclasses.replace(
+                tiny_config, round=1,
+                distributed_algorithm="multiround_shapley_value",
+                aggregation="median",
+            ),
+            setup_logging=False,
+        )
