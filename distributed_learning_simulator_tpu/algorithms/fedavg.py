@@ -20,8 +20,7 @@ import optax
 
 from distributed_learning_simulator_tpu.algorithms.base import Algorithm
 from distributed_learning_simulator_tpu.ops.aggregate import (
-    coordinate_median,
-    trimmed_mean,
+    aggregate,
     weighted_mean,
 )
 from distributed_learning_simulator_tpu.parallel.engine import make_local_train_fn
@@ -176,12 +175,9 @@ class FedAvg(Algorithm):
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )
-                if aggregation == "median":
-                    new_global = coordinate_median(client_params)
-                elif aggregation == "trimmed_mean":
-                    new_global = trimmed_mean(client_params, cfg.trim_ratio)
-                else:
-                    new_global = weighted_mean(client_params, part_sizes)
+                new_global = aggregate(
+                    client_params, part_sizes, aggregation, cfg.trim_ratio
+                )
                 if keep:
                     aux["client_params"] = client_params
                     if idx is not None:

@@ -38,11 +38,7 @@ from distributed_learning_simulator_tpu.config import ExperimentConfig
 from distributed_learning_simulator_tpu.data.partition import ClientData
 from distributed_learning_simulator_tpu.data.registry import Dataset, get_dataset
 from distributed_learning_simulator_tpu.models.registry import get_model, init_params
-from distributed_learning_simulator_tpu.ops.aggregate import (
-    coordinate_median,
-    trimmed_mean,
-    weighted_mean,
-)
+from distributed_learning_simulator_tpu.ops.aggregate import aggregate
 from distributed_learning_simulator_tpu.parallel.engine import (
     make_decoder,
     make_eval_fn,
@@ -103,13 +99,9 @@ class ThreadedServer:
             [self._buffer[i][0] for i in range(self.worker_number)],
             dtype=jnp.float32,
         )
-        agg = self.config.aggregation.lower()
-        if agg == "median":
-            aggregated = coordinate_median(stacked)
-        elif agg == "trimmed_mean":
-            aggregated = trimmed_mean(stacked, self.config.trim_ratio)
-        else:
-            aggregated = weighted_mean(stacked, sizes)
+        aggregated = aggregate(
+            stacked, sizes, self.config.aggregation, self.config.trim_ratio
+        )
         aggregated = self._process_aggregated_parameter(aggregated)
         metrics = {
             k: float(v)

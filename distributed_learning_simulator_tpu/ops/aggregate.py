@@ -79,7 +79,9 @@ def coordinate_median(stacked_tree):
     previous model.
     """
     return jax.tree_util.tree_map(
-        lambda x: jnp.median(x.astype(jnp.float32), axis=0).astype(x.dtype),
+        # nanmedian: a poisoned client whose local training diverged to NaN
+        # must not poison the aggregate (jnp.median would propagate it).
+        lambda x: jnp.nanmedian(x.astype(jnp.float32), axis=0).astype(x.dtype),
         stacked_tree,
     )
 
@@ -99,11 +101,29 @@ def trimmed_mean(stacked_tree, trim_ratio: float):
             raise ValueError(
                 f"trim_ratio {trim_ratio} removes all {n} clients"
             )
+        # jnp.sort places NaNs last, so for k >= 1 up to k NaN uploads land
+        # in the trimmed top-k; with k == 0 this is a plain mean and offers
+        # no robustness (NaN included).
         s = jnp.sort(x.astype(jnp.float32), axis=0)
         kept = s[k : n - k] if k else s
         return jnp.mean(kept, axis=0).astype(x.dtype)
 
     return jax.tree_util.tree_map(_leaf, stacked_tree)
+
+
+def aggregate(stacked_tree, weights, rule: str, trim_ratio: float = 0.1):
+    """Dispatch over the aggregation rules (single source of truth for the
+    vmap fast path and the thread-per-client server)."""
+    rule = rule.lower()
+    if rule == "median":
+        return coordinate_median(stacked_tree)
+    if rule == "trimmed_mean":
+        return trimmed_mean(stacked_tree, trim_ratio)
+    if rule == "mean":
+        return weighted_mean(stacked_tree, weights)
+    raise ValueError(
+        f"unknown aggregation {rule!r}; known: mean, median, trimmed_mean"
+    )
 
 
 def subset_masks_all(n_clients: int, include_empty: bool = True) -> np.ndarray:

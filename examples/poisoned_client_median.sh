@@ -1,0 +1,10 @@
+#!/bin/bash
+# Poisoning defense demo: the heterogeneity experiment (reference
+# simulator_backup.py swaps worker 0's training data) combined with the
+# Byzantine-robust coordinate-median aggregator this framework adds.
+# Compare the accuracy trajectory with and without --aggregation median.
+python -m distributed_learning_simulator_tpu.simulator_heterogeneous \
+  --dataset_name cifar10 --model_name cnn_tpu \
+  --distributed_algorithm fed \
+  --worker_number 8 --round 10 --epoch 1 --learning_rate 0.1 \
+  --aggregation median --log_level INFO

@@ -47,6 +47,18 @@ def test_trimmed_mean_matches_numpy():
     np.testing.assert_allclose(out, s[2:-2].mean(axis=0), rtol=1e-5)
 
 
+def test_median_survives_nan_upload():
+    """A client whose local training diverged to NaN (the strongest form of
+    poisoning) must not poison the median aggregate."""
+    honest = np.random.default_rng(2).normal(1.0, 0.01, size=(4, 3))
+    stack = {"w": jnp.asarray(
+        np.concatenate([honest, np.full((1, 3), np.nan)]), jnp.float32
+    )}
+    out = np.asarray(coordinate_median(stack)["w"])
+    assert np.all(np.isfinite(out))
+    assert np.abs(out - 1.0).max() < 0.05
+
+
 def test_trimmed_mean_rejects_full_trim():
     with pytest.raises(ValueError, match="removes all"):
         trimmed_mean({"w": jnp.zeros((4, 2))}, 0.5)

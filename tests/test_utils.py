@@ -1,0 +1,53 @@
+"""Small utility modules: tracing no-ops, multihost init, payload math."""
+
+import numpy as np
+
+from distributed_learning_simulator_tpu.utils.tracing import (
+    annotate,
+    profile_session,
+)
+
+
+def test_profile_session_noop_and_annotate():
+    with profile_session(None):
+        with annotate("test_region"):
+            x = np.arange(4).sum()
+    assert x == 6
+
+
+def test_profile_session_writes_trace(tmp_path):
+    import jax.numpy as jnp
+
+    with profile_session(str(tmp_path / "trace")):
+        _ = jnp.ones(8).sum()
+    assert (tmp_path / "trace").exists()
+
+
+def test_multihost_initialize_single_process():
+    """On a single process, initialize is a no-op that reports devices."""
+    from distributed_learning_simulator_tpu.parallel.multihost import (
+        initialize_multihost,
+    )
+
+    n = initialize_multihost()
+    assert n >= 1
+
+
+def test_payload_accounting():
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.ops.payload import (
+        compression_ratio,
+        payload_bytes,
+        quantized_payload_bytes,
+        sign_payload_bytes,
+    )
+
+    tree = {"a": jnp.zeros((10, 10), jnp.float32), "b": jnp.zeros((50,), jnp.float32)}
+    raw = payload_bytes(tree)
+    assert raw == 150 * 4
+    q = quantized_payload_bytes(tree, 256)
+    assert q < raw
+    s = sign_payload_bytes(tree)
+    assert s < q
+    assert compression_ratio(raw, q) > 1.0
