@@ -85,6 +85,12 @@ class ExperimentConfig:
     gtg_max_permutations: int = 500
 
     # --- execution ----------------------------------------------------------
+    # "vmap": the fast path — one jitted round program over the client axis.
+    # "threaded": thread-per-client over the native C++ queue/pool runtime
+    # (the reference's architecture, servers/server.py + simulator.py:60-69;
+    # FedAvg only). Semantically equivalent, ~orders slower; exists for
+    # architecture parity and as a differential-testing oracle.
+    execution_mode: str = "vmap"
     mesh_devices: int | None = None  # None = single-device vmap path
     # Max clients trained concurrently inside one round program. None = all
     # at once (pure vmap). At large N the per-client params/grads/momentum
@@ -140,6 +146,11 @@ class ExperimentConfig:
             )
         if not 0.0 <= self.trim_ratio < 0.5:
             raise ValueError("trim_ratio must be in [0, 0.5)")
+        if self.execution_mode.lower() not in ("vmap", "threaded"):
+            raise ValueError(
+                f"unknown execution_mode {self.execution_mode!r}; known: "
+                "vmap, threaded"
+            )
         server_opt = self.server_optimizer_name.lower()
         if server_opt not in ("none", "", "sgd", "adam"):
             raise ValueError(

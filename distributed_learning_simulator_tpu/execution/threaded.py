@@ -179,6 +179,18 @@ def run_threaded_simulation(
             "threaded execution mode does not support server optimizers; "
             "use run_simulation for FedAvgM/FedAdam"
         )
+    if config.participation_fraction < 1.0:
+        # Thread-per-client barriers on every worker (the reference's
+        # behavior); sampling would be silently ignored — reject instead.
+        raise ValueError(
+            "threaded execution mode trains all clients every round; "
+            "participation_fraction < 1 requires the vmap execution mode"
+        )
+    if config.profile_dir:
+        get_logger().warning(
+            "threaded execution mode ignores profile_dir (tracing is wired "
+            "into the vmap round loop only)"
+        )
     if dataset is None:
         dataset = get_dataset(
             config.dataset_name, data_dir=config.data_dir, seed=config.seed,

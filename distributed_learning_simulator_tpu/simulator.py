@@ -164,6 +164,16 @@ def run_simulation(
     and pass it in.
     """
     config.validate()
+    if config.execution_mode.lower() == "threaded":
+        # Honor the flag from EVERY entry point (heterogeneous CLI, bench,
+        # programmatic callers), not just simulator.main.
+        from distributed_learning_simulator_tpu.execution.threaded import (
+            run_threaded_simulation,
+        )
+
+        return run_threaded_simulation(
+            config, dataset=dataset, client_data=client_data
+        )
     logger = get_logger()
     set_level(config.log_level)
     if config.compilation_cache_dir:

@@ -160,6 +160,17 @@ def test_threaded_median_aggregation(tiny_config):
     assert all(np.isfinite(h["test_loss"]) for h in res["history"])
 
 
+def test_threaded_mode_via_config_flag(tiny_config):
+    """execution_mode='threaded' routes run_simulation (hence every entry
+    point) through the native-runtime thread-per-client path."""
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(tiny_config, round=2,
+                              execution_mode="threaded")
+    res = run_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 2
+
+
 def test_threaded_rejects_other_algorithms(tiny_config):
     from distributed_learning_simulator_tpu.execution.threaded import (
         run_threaded_simulation,
