@@ -46,8 +46,10 @@ class ExperimentConfig:
     # FedAvg aggregation rule (ops/aggregate.py): "mean" (dataset-size-
     # weighted, the reference's only rule), or the Byzantine-robust
     # "median" / "trimmed_mean" (drop trim_ratio of extremes per
-    # coordinate). Robust rules materialize the full per-client parameter
-    # stack, so large models cap the feasible client count.
+    # coordinate) / "krum" (pick the client update nearest its neighbors;
+    # trim_ratio doubles as the assumed Byzantine fraction). Robust rules
+    # materialize the full per-client parameter stack, so large models cap
+    # the feasible client count.
     aggregation: str = "mean"
     trim_ratio: float = 0.1
     # --- server optimizer (FedOpt family; exceeds the reference) -----------
@@ -139,10 +141,11 @@ class ExperimentConfig:
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 
         get_augment(self.augment)  # fail fast on unknown augmentation names
-        if self.aggregation.lower() not in ("mean", "median", "trimmed_mean"):
+        if self.aggregation.lower() not in ("mean", "median", "trimmed_mean",
+                                            "krum"):
             raise ValueError(
                 f"unknown aggregation {self.aggregation!r}; known: mean, "
-                "median, trimmed_mean"
+                "median, trimmed_mean, krum"
             )
         if not 0.0 <= self.trim_ratio < 0.5:
             raise ValueError("trim_ratio must be in [0, 0.5)")

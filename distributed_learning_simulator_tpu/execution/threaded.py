@@ -186,10 +186,20 @@ def run_threaded_simulation(
             "threaded execution mode trains all clients every round; "
             "participation_fraction < 1 requires the vmap execution mode"
         )
+    if (config.checkpoint_dir and config.checkpoint_every) or config.resume:
+        # Long-job persistence is wired into the vmap round loop only;
+        # silently dropping it would lose a crashed run's progress.
+        raise ValueError(
+            "threaded execution mode does not support checkpoint/resume; "
+            "use the vmap execution mode"
+        )
+    from distributed_learning_simulator_tpu.utils.logging import set_level
+
+    set_level(config.log_level)
     if config.profile_dir:
         get_logger().warning(
-            "threaded execution mode ignores profile_dir (tracing is wired "
-            "into the vmap round loop only)"
+            "threaded execution mode ignores profile_dir and writes no "
+            "log-file/metrics.jsonl artifacts (vmap round loop only)"
         )
     if dataset is None:
         dataset = get_dataset(

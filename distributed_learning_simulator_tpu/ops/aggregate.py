@@ -111,18 +111,64 @@ def trimmed_mean(stacked_tree, trim_ratio: float):
     return jax.tree_util.tree_map(_leaf, stacked_tree)
 
 
+def krum(stacked_tree, n_byzantine: int = 0):
+    """Krum (Blanchard et al.): select the single client update closest to
+    its n - f - 2 nearest neighbors (f = assumed Byzantine count).
+
+    Robust to f colluding adversaries whose updates are far from the honest
+    cluster. NaN uploads are mapped to a huge finite magnitude first, so a
+    diverged client scores itself out rather than corrupting the distance
+    matrix. O(n^2 * P) — fine for hundreds of clients; the [n, P] flattened
+    stack must fit in HBM.
+    """
+    leaves = jax.tree_util.tree_leaves(stacked_tree)
+    n = leaves[0].shape[0]
+    if n < 2 * n_byzantine + 3:
+        # Below this bound (Blanchard et al.), f colluding identical uploads
+        # can have pairwise distance 0 and win the closest-neighbor score.
+        raise ValueError(
+            f"krum needs n >= 2f + 3 clients (n={n}, assumed Byzantine "
+            f"f={n_byzantine}); lower trim_ratio or add clients"
+        )
+    x = jnp.concatenate(
+        [
+            jnp.nan_to_num(
+                leaf.reshape(n, -1).astype(jnp.float32),
+                nan=1e30, posinf=1e30, neginf=-1e30,
+            )
+            for leaf in leaves
+        ],
+        axis=1,
+    )
+    sq = jnp.sum(x * x, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
+    d2 = d2 + jnp.where(jnp.eye(n, dtype=bool), jnp.inf, 0.0)
+    k = max(1, min(n - n_byzantine - 2, n - 1))
+    nearest = jnp.sort(d2, axis=1)[:, :k]
+    best = jnp.argmin(jnp.sum(nearest, axis=1))
+    return jax.tree_util.tree_map(lambda leaf: leaf[best], stacked_tree)
+
+
 def aggregate(stacked_tree, weights, rule: str, trim_ratio: float = 0.1):
     """Dispatch over the aggregation rules (single source of truth for the
-    vmap fast path and the thread-per-client server)."""
+    vmap fast path and the thread-per-client server).
+
+    For ``krum``, ``trim_ratio`` doubles as the assumed Byzantine fraction
+    (f = floor(trim_ratio * n_clients)).
+    """
     rule = rule.lower()
     if rule == "median":
         return coordinate_median(stacked_tree)
     if rule == "trimmed_mean":
         return trimmed_mean(stacked_tree, trim_ratio)
+    if rule == "krum":
+        n = jax.tree_util.tree_leaves(stacked_tree)[0].shape[0]
+        return krum(stacked_tree, n_byzantine=int(trim_ratio * n))
     if rule == "mean":
         return weighted_mean(stacked_tree, weights)
     raise ValueError(
-        f"unknown aggregation {rule!r}; known: mean, median, trimmed_mean"
+        f"unknown aggregation {rule!r}; known: mean, median, trimmed_mean, "
+        "krum"
     )
 
 

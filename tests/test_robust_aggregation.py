@@ -13,6 +13,7 @@ import pytest
 
 from distributed_learning_simulator_tpu.ops.aggregate import (
     coordinate_median,
+    krum,
     trimmed_mean,
     weighted_mean,
 )
@@ -62,6 +63,32 @@ def test_median_survives_nan_upload():
 def test_trimmed_mean_rejects_full_trim():
     with pytest.raises(ValueError, match="removes all"):
         trimmed_mean({"w": jnp.zeros((4, 2))}, 0.5)
+
+
+def test_krum_picks_honest_client():
+    stacked = _stack_with_outlier()
+    out = np.asarray(krum(stacked, n_byzantine=1)["w"])
+    assert np.abs(out - 1.0).max() < 0.05  # one of the honest clients
+
+
+def test_krum_survives_nan_upload():
+    honest = np.random.default_rng(3).normal(1.0, 0.01, size=(4, 3))
+    stack = {"w": jnp.asarray(
+        np.concatenate([honest, np.full((1, 3), np.nan)]), jnp.float32
+    )}
+    out = np.asarray(krum(stack, n_byzantine=1)["w"])
+    assert np.all(np.isfinite(out))
+    assert np.abs(out - 1.0).max() < 0.05
+
+
+def test_end_to_end_krum(tiny_config):
+    res = run_simulation(
+        dataclasses.replace(tiny_config, round=3, aggregation="krum"),
+        setup_logging=False,
+    )
+    accs = [h["test_accuracy"] for h in res["history"]]
+    assert all(np.isfinite(h["test_loss"]) for h in res["history"])
+    assert accs[-1] > 0.15  # a single client's params still learn
 
 
 def test_end_to_end_median(tiny_config):
