@@ -63,9 +63,7 @@ class FedAvg(Algorithm):
         materialize = keep or aggregation != "mean"
         chunk = cfg.client_chunk_size
         frac = cfg.participation_fraction
-        n_participants = (
-            n_clients if frac >= 1.0 else max(1, round(frac * n_clients))
-        )
+        n_participants = cfg.cohort_size(n_clients)
 
         def train_clients(global_params, state, x, y, m, keys):
             """Materializing path: returns every client's params stacked
@@ -178,6 +176,22 @@ class FedAvg(Algorithm):
                 new_global = aggregate(
                     client_params, part_sizes, aggregation, cfg.trim_ratio
                 )
+                if aggregation != "mean":
+                    # Robust rules promise a usable model even under
+                    # poisoning; if EVERY client diverged in the same round
+                    # (all candidates masked), keep the previous global
+                    # instead of a NaN aggregate. The plain mean keeps
+                    # propagate-NaN semantics (reference parity).
+                    finite = jnp.all(jnp.stack([
+                        jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+                        for leaf in jax.tree_util.tree_leaves(new_global)
+                    ]))
+                    new_global = jax.tree_util.tree_map(
+                        lambda agg, prev: jnp.where(
+                            finite, agg, prev.astype(agg.dtype)
+                        ),
+                        new_global, global_params,
+                    )
                 if keep:
                     aux["client_params"] = client_params
                     if idx is not None:

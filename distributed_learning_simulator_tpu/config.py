@@ -127,6 +127,15 @@ class ExperimentConfig:
     checkpoint_every: int = 0  # rounds; 0 = disabled
     resume: bool = False
 
+    def cohort_size(self, n_clients: int | None = None) -> int:
+        """Participants per round: the single source of the sampling formula
+        (used by the round builder, the OOM hint, and krum's feasibility
+        check — keep them in lockstep)."""
+        n = self.worker_number if n_clients is None else n_clients
+        if self.participation_fraction >= 1.0:
+            return n
+        return max(1, round(self.participation_fraction * n))
+
     def validate(self) -> "ExperimentConfig":
         if self.worker_number < 1:
             raise ValueError("worker_number must be >= 1")
@@ -149,6 +158,15 @@ class ExperimentConfig:
             )
         if not 0.0 <= self.trim_ratio < 0.5:
             raise ValueError("trim_ratio must be in [0, 0.5)")
+        if self.aggregation.lower() == "krum":
+            cohort = self.cohort_size()
+            f = int(self.trim_ratio * cohort)
+            if cohort < 2 * f + 3:
+                raise ValueError(
+                    f"krum needs n >= 2f + 3 participants (cohort={cohort}, "
+                    f"assumed Byzantine f={f}); lower trim_ratio or raise "
+                    "worker_number/participation_fraction"
+                )
         if self.execution_mode.lower() not in ("vmap", "threaded"):
             raise ValueError(
                 f"unknown execution_mode {self.execution_mode!r}; known: "

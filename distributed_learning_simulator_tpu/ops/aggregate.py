@@ -111,15 +111,23 @@ def trimmed_mean(stacked_tree, trim_ratio: float):
     return jax.tree_util.tree_map(_leaf, stacked_tree)
 
 
-def krum(stacked_tree, n_byzantine: int = 0):
+def krum(stacked_tree, n_byzantine: int = 0, weights=None):
     """Krum (Blanchard et al.): select the single client update closest to
     its n - f - 2 nearest neighbors (f = assumed Byzantine count).
 
     Robust to f colluding adversaries whose updates are far from the honest
-    cluster. NaN uploads are mapped to a huge finite magnitude first, so a
-    diverged client scores itself out rather than corrupting the distance
-    matrix. O(n^2 * P) — fine for hundreds of clients; the [n, P] flattened
-    stack must fit in HBM.
+    cluster. Two classes of degenerate candidates are masked out of both the
+    candidate set and everyone's neighbor lists:
+
+      * non-finite uploads (local training diverged to NaN/inf), and
+      * zero-weight clients (``weights[i] <= 0``, e.g. empty Dirichlet
+        shards) — these return the broadcast params bit-identical, so two
+        of them would otherwise win the closest-pair score with distance 0
+        and freeze the global model.
+
+    Masked entries use a large FINITE sentinel distance (an inf/NaN sentinel
+    would corrupt the score sums they appear in). O(n^2 * P); the [n, P]
+    flattened stack must fit in HBM.
     """
     leaves = jax.tree_util.tree_leaves(stacked_tree)
     n = leaves[0].shape[0]
@@ -130,22 +138,24 @@ def krum(stacked_tree, n_byzantine: int = 0):
             f"krum needs n >= 2f + 3 clients (n={n}, assumed Byzantine "
             f"f={n_byzantine}); lower trim_ratio or add clients"
         )
-    x = jnp.concatenate(
-        [
-            jnp.nan_to_num(
-                leaf.reshape(n, -1).astype(jnp.float32),
-                nan=1e30, posinf=1e30, neginf=-1e30,
-            )
-            for leaf in leaves
-        ],
-        axis=1,
-    )
+    flat = [leaf.reshape(n, -1).astype(jnp.float32) for leaf in leaves]
+    bad = jnp.zeros((n,), dtype=bool)
+    for row in flat:
+        bad = bad | ~jnp.all(jnp.isfinite(row), axis=1)
+    if weights is not None:
+        bad = bad | (jnp.asarray(weights, jnp.float32) <= 0.0)
+    x = jnp.concatenate([jnp.nan_to_num(row, nan=0.0) for row in flat], axis=1)
     sq = jnp.sum(x * x, axis=1)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
-    d2 = d2 + jnp.where(jnp.eye(n, dtype=bool), jnp.inf, 0.0)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (x @ x.T), 0.0)
+    big = jnp.float32(1e30)
+    masked = bad[:, None] | bad[None, :] | jnp.eye(n, dtype=bool)
+    d2 = jnp.where(masked, big, d2)
     k = max(1, min(n - n_byzantine - 2, n - 1))
     nearest = jnp.sort(d2, axis=1)[:, :k]
-    best = jnp.argmin(jnp.sum(nearest, axis=1))
+    # The extra bad-penalty keeps masked clients out of argmin even in the
+    # degenerate all-sentinel case (more masked clients than k neighbors).
+    scores = jnp.sum(nearest, axis=1) + bad.astype(jnp.float32) * big * n
+    best = jnp.argmin(scores)
     return jax.tree_util.tree_map(lambda leaf: leaf[best], stacked_tree)
 
 
@@ -163,7 +173,8 @@ def aggregate(stacked_tree, weights, rule: str, trim_ratio: float = 0.1):
         return trimmed_mean(stacked_tree, trim_ratio)
     if rule == "krum":
         n = jax.tree_util.tree_leaves(stacked_tree)[0].shape[0]
-        return krum(stacked_tree, n_byzantine=int(trim_ratio * n))
+        return krum(stacked_tree, n_byzantine=int(trim_ratio * n),
+                    weights=weights)
     if rule == "mean":
         return weighted_mean(stacked_tree, weights)
     raise ValueError(

@@ -88,7 +88,7 @@ def _oom_hint(config, global_params, n_clients: int, site: str = "round"):
         if "out of memory" not in str(e).lower():
             raise
         # In-flight clients = chunk bounded by the sampled cohort size.
-        cohort = max(1, round(config.participation_fraction * n_clients))
+        cohort = config.cohort_size(n_clients)
         current = min(config.client_chunk_size or cohort, cohort)
         eval_note = (
             f" This OOM surfaced at {site}: if lowering client_chunk_size "

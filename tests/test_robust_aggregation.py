@@ -81,6 +81,80 @@ def test_krum_survives_nan_upload():
     assert np.abs(out - 1.0).max() < 0.05
 
 
+def test_krum_excludes_zero_weight_clients():
+    """Empty-shard clients return the broadcast params bit-identical; two of
+    them must not win krum with pairwise distance 0 (frozen model bug)."""
+    honest = np.random.default_rng(4).normal(1.0, 0.01, size=(3, 3))
+    stale = np.zeros((3, 3))  # three identical zero-sample uploads
+    stack = {"w": jnp.asarray(np.concatenate([honest, stale]), jnp.float32)}
+    weights = np.array([10.0, 10.0, 10.0, 0.0, 0.0, 0.0])
+    out = np.asarray(krum(stack, n_byzantine=0, weights=weights)["w"])
+    assert np.abs(out - 1.0).max() < 0.05  # an honest client, not the stale 0s
+
+
+def test_krum_survives_many_nan_uploads():
+    """More NaN uploads than the assumed f must still never be selected."""
+    honest = np.random.default_rng(5).normal(1.0, 0.01, size=(4, 3))
+    nans = np.full((3, 3), np.nan)
+    stack = {"w": jnp.asarray(np.concatenate([honest, nans]), jnp.float32)}
+    out = np.asarray(krum(stack, n_byzantine=0)["w"])
+    assert np.all(np.isfinite(out))
+    assert np.abs(out - 1.0).max() < 0.05
+
+
+def test_all_diverged_cohort_keeps_previous_model(tiny_config):
+    """If every client uploads NaN in the same round, robust rules keep the
+    previous global model instead of a NaN aggregate (jit-level check via
+    the round function)."""
+    import jax
+
+    from distributed_learning_simulator_tpu.factory import get_algorithm
+    from distributed_learning_simulator_tpu.parallel.engine import (
+        make_eval_fn,
+        make_optimizer,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config, aggregation="median", learning_rate=1e30,  # diverges
+        n_train=128, worker_number=4, batch_size=16,
+    )
+    from distributed_learning_simulator_tpu.data.registry import get_dataset
+    from distributed_learning_simulator_tpu.models.registry import (
+        get_model,
+        init_params,
+    )
+    from distributed_learning_simulator_tpu.simulator import build_client_data
+
+    ds = get_dataset("synthetic", n_train=128, n_test=64, seed=0)
+    cd = build_client_data(cfg, ds)
+    model = get_model("mlp", num_classes=ds.num_classes)
+    gp = init_params(model, ds.x_train[:1], seed=0)
+    opt = make_optimizer("sgd", cfg.learning_rate)
+    algo = get_algorithm("fed", cfg)
+    algo.prepare(model.apply, make_eval_fn(model.apply))
+    round_fn = algo.make_round_fn(model.apply, opt, cd.n_clients)
+    import jax.numpy as _jnp
+
+    new_global, _, _ = jax.jit(round_fn)(
+        gp, None, _jnp.asarray(cd.x), _jnp.asarray(cd.y),
+        _jnp.asarray(cd.mask), _jnp.asarray(cd.sizes), jax.random.key(0),
+    )
+    for got, prev in zip(jax.tree_util.tree_leaves(new_global),
+                         jax.tree_util.tree_leaves(gp)):
+        # every client NaN'd out (lr=1e30), so the fallback must return the
+        # previous global model bit-exactly
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(prev))
+
+
+def test_krum_infeasible_config_fails_fast(tiny_config):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="2f \\+ 3"):
+        dataclasses.replace(
+            tiny_config, aggregation="krum", worker_number=5, trim_ratio=0.4
+        ).validate()
+
+
 def test_end_to_end_krum(tiny_config):
     res = run_simulation(
         dataclasses.replace(tiny_config, round=3, aggregation="krum"),

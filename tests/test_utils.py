@@ -33,6 +33,25 @@ def test_multihost_initialize_single_process():
     assert n >= 1
 
 
+def test_oom_hint_rewrites_device_oom():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.simulator import _oom_hint
+
+    cfg = ExperimentConfig(worker_number=1000, client_chunk_size=250)
+    params = {"w": jnp.zeros((1000, 100), jnp.float32)}
+    with pytest.raises(RuntimeError, match="client_chunk_size="):
+        with _oom_hint(cfg, params, 1000):
+            raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm")
+    # non-OOM errors pass through untouched
+    with pytest.raises(jax.errors.JaxRuntimeError, match="something else"):
+        with _oom_hint(cfg, params, 1000):
+            raise jax.errors.JaxRuntimeError("something else")
+
+
 def test_payload_accounting():
     import jax.numpy as jnp
 
