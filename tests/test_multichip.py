@@ -70,6 +70,63 @@ def test_uneven_clients_rejected(tiny_config):
         run_simulation(cfg, setup_logging=False)
 
 
+def _driver_subprocess(code):
+    """Run `code` exactly as the driver invokes the graft entry: fresh
+    interpreter, ONLY XLA_FLAGS set (no JAX_PLATFORMS, no conftest)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "DLS_ALLOW_CPU_MESH_FALLBACK")}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    return subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+def test_graft_entry_dryrun_driver_identical():
+    """dryrun_multichip must pin the platform itself so it never dispatches
+    to an accelerator plugin, even one that sitecustomize force-registers
+    ahead of JAX_PLATFORMS (the round-1 MULTICHIP failure mode)."""
+    proc = _driver_subprocess(
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(8); "
+        "print('DRYRUN_OK')"
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "DRYRUN_OK" in proc.stdout
+
+
+def test_graft_entry_dryrun_rejects_initialized_accelerator():
+    """If JAX already initialized on a non-CPU backend in this interpreter,
+    dryrun_multichip must fail fast with a clear message — config.update
+    is a silent no-op post-init, so silent accelerator dispatch is the
+    alternative (the round-1 failure mode)."""
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "try:\n"
+        "    jnp.zeros(1).block_until_ready()\n"  # initialize default backend
+        "except Exception:\n"
+        "    print('BROKEN_ACCEL_INIT')\n"  # accel plugin broken: N/A here
+        "    raise SystemExit(0)\n"
+        "import __graft_entry__\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    print('CPU_ONLY_BOX')\n"  # no accelerator here: vacuous pass
+        "else:\n"
+        "    try:\n"
+        "        __graft_entry__.dryrun_multichip(8)\n"
+        "    except RuntimeError as e:\n"
+        "        assert 'fresh process' in str(e), e\n"
+        "        print('GUARD_RAISED')\n"
+        "    else:\n"
+        "        raise SystemExit('dryrun ran on initialized accelerator')\n"
+    )
+    proc = _driver_subprocess(code)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert any(s in proc.stdout for s in
+               ("GUARD_RAISED", "CPU_ONLY_BOX", "BROKEN_ACCEL_INIT"))
+
+
 def test_graft_entry_dryrun():
     """The driver's multi-chip compile check must pass on 8 virtual devices."""
     import importlib.util
