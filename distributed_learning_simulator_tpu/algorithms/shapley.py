@@ -210,8 +210,9 @@ class GTGShapley(FedAvg):
     worker (:42-49); within a permutation, prefix utilities are only
     "refreshed" while the running value is at least ``eps`` away from the
     full-aggregation metric (:51-61), with subset metrics memoized across the
-    round; convergence when the running SV estimate's relative change over
-    the last ``last_k`` records stays below ``converge_criteria`` (:79-100).
+    round; convergence when each of the last ``last_k`` running-mean SV
+    estimates sits within ``converge_criteria`` relative distance of the
+    current estimate (:79-100).
     """
 
     name = "GTG_shapley_value"
@@ -237,20 +238,24 @@ class GTGShapley(FedAvg):
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
         converge_min = max(30, n)  # GTG_shapley_value_server.py:15
-        if len(records) < max(converge_min, self.last_k + 1):
+        if len(records) <= converge_min:
             return False
+        # Reference semantics (GTG_shapley_value_server.py:82-91): each of
+        # the last_k running means is compared to the FINAL running mean —
+        # relative error averaged over the worker axis — and sampling stops
+        # when the largest of those k errors is within converge_criteria.
+        # (NOT successive diffs: a running mean drifting steadily has small
+        # per-step changes but large distance-to-final, and the reference
+        # keeps sampling in that regime.)
         all_arr = np.stack(records)
         cumsum = np.cumsum(all_arr, axis=0)
         counts = np.arange(1, len(records) + 1)[:, None]
-        running_means = cumsum / counts
-        recent = running_means[-(self.last_k + 1) :]
-        # Reference semantics (GTG_shapley_value_server.py:82-91): per-step
-        # relative change averaged over the worker axis, all of the last_k
-        # steps below the criteria. (Elementwise max would let one
-        # near-zero-SV client block convergence forever.)
-        denom = np.abs(recent[-1]) + 1e-12
-        per_step = np.mean(np.abs(np.diff(recent, axis=0)) / denom, axis=1)
-        return bool(per_step.max() < self.converge_criteria)
+        running_means = (cumsum / counts)[-self.last_k :]
+        final = running_means[-1:]
+        errors = np.mean(
+            np.abs(running_means - final) / (np.abs(final) + 1e-12), axis=1
+        )
+        return bool(np.max(errors) <= self.converge_criteria)
 
     def post_round(self, ctx: RoundContext) -> dict:
         n = int(ctx.sizes.shape[0])

@@ -158,6 +158,16 @@ class ExperimentConfig:
             )
         if not 0.0 <= self.trim_ratio < 0.5:
             raise ValueError("trim_ratio must be in [0, 0.5)")
+        if self.aggregation.lower() == "trimmed_mean":
+            cohort = self.cohort_size()
+            if int(self.trim_ratio * cohort) < 1:
+                raise ValueError(
+                    f"trimmed_mean with trim_ratio={self.trim_ratio} and a "
+                    f"cohort of {cohort} trims k=0 clients — a plain mean "
+                    "with zero robustness (one NaN upload poisons the "
+                    "round); raise trim_ratio or the cohort size so "
+                    "trim_ratio * cohort >= 1"
+                )
         if self.aggregation.lower() == "krum":
             cohort = self.cohort_size()
             f = int(self.trim_ratio * cohort)

@@ -58,7 +58,7 @@ class ThreadedServer:
     """Queue-owning server (reference servers/server.py + fed_server.py)."""
 
     def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
-                 init_params_tree):
+                 init_params_tree, metrics_path: str | None = None):
         self.config = config
         self.worker_number = config.worker_number
         self._evaluate = evaluate
@@ -66,6 +66,7 @@ class ThreadedServer:
         self._buffer: dict[int, tuple[float, dict]] = {}
         self._round = 0
         self.history: list[dict] = []
+        self.metrics_path = metrics_path
         self.prev_model = init_params_tree
         self._round_t0 = time.perf_counter()
         self.worker_data_queue = NativeTaskQueue(
@@ -102,17 +103,34 @@ class ThreadedServer:
         aggregated = aggregate(
             stacked, sizes, self.config.aggregation, self.config.trim_ratio
         )
+        if self.config.aggregation.lower() != "mean":
+            # Same finite-or-previous-model guard as the vmap path
+            # (fedavg.py round_fn): an all-diverged cohort must not poison
+            # the global model — the two execution modes are a differential
+            # oracle pair and must agree in exactly these scenarios.
+            finite = all(
+                bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
+                for leaf in jax.tree_util.tree_leaves(aggregated)
+            )
+            if not finite:
+                aggregated = self.prev_model
         aggregated = self._process_aggregated_parameter(aggregated)
         metrics = {
             k: float(v)
             for k, v in self._evaluate(aggregated, *self._eval_batches).items()
         }
-        self.history.append({
+        record = {
             "round": self._round,
             "test_accuracy": metrics["accuracy"],
             "test_loss": metrics["loss"],
             "round_seconds": time.perf_counter() - self._round_t0,
-        })
+        }
+        self.history.append(record)
+        if self.metrics_path:
+            import json
+
+            with open(self.metrics_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
         get_logger().info(
             "threaded round %d: test_acc=%.4f test_loss=%.4f",
             self._round, metrics["accuracy"], metrics["loss"],
@@ -160,6 +178,7 @@ def run_threaded_simulation(
     config: ExperimentConfig,
     dataset: Dataset | None = None,
     client_data: ClientData | None = None,
+    setup_logging: bool = True,
 ):
     """Run FedAvg in thread-per-client mode; returns a result dict.
 
@@ -193,13 +212,28 @@ def run_threaded_simulation(
             "threaded execution mode does not support checkpoint/resume; "
             "use the vmap execution mode"
         )
-    from distributed_learning_simulator_tpu.utils.logging import set_level
+    from distributed_learning_simulator_tpu.utils.logging import (
+        set_level,
+        set_run_artifacts,
+    )
 
     set_level(config.log_level)
+    metrics_path = None
+    if setup_logging:
+        # Same per-run artifact contract as the vmap path: a log file under
+        # log/<algo>/<dataset>/<model>/ plus metrics.jsonl next to it.
+        import os
+
+        log_path, log_dir = set_run_artifacts(
+            config.log_root, config.distributed_algorithm,
+            config.dataset_name, config.model_name,
+        )
+        metrics_path = os.path.join(log_dir, "metrics.jsonl")
+        get_logger().info("log file: %s", log_path)
     if config.profile_dir:
         get_logger().warning(
-            "threaded execution mode ignores profile_dir and writes no "
-            "log-file/metrics.jsonl artifacts (vmap round loop only)"
+            "threaded execution mode ignores profile_dir (vmap round loop "
+            "only)"
         )
     if dataset is None:
         dataset = get_dataset(
@@ -238,7 +272,8 @@ def run_threaded_simulation(
     )
 
     t_start = time.perf_counter()
-    server = ThreadedServer(config, evaluate, eval_batches, params)
+    server = ThreadedServer(config, evaluate, eval_batches, params,
+                            metrics_path=metrics_path)
     pool = NativeThreadPool(config.worker_number)
     try:
         for worker_id in range(client_data.n_clients):

@@ -66,49 +66,97 @@ def subset_weighted_mean(stacked_tree, weights, mask, fallback_tree):
     return jax.tree_util.tree_map(_leaf, stacked_tree, fallback_tree)
 
 
-def coordinate_median(stacked_tree):
+def coordinate_median(stacked_tree, weights=None):
     """Coordinate-wise median over the client axis (Byzantine-robust).
 
     Robust-aggregation extension beyond the reference (its weighted mean,
     fed_server.py:58-66, is the only aggregator there — yet its own
     heterogeneity experiment injects a poisoned client,
-    simulator_backup.py:71-77). Unweighted by construction: a median has no
-    meaningful per-client weighting. Clients whose local training saw no
-    real samples return the broadcast global params unchanged (masked loss
-    gives zero gradients), which safely biases the median toward the
-    previous model.
+    simulator_backup.py:71-77). The statistic itself is unweighted (a median
+    has no meaningful per-client weighting), but ``weights`` are used as a
+    participation mask: clients with ``weights[i] <= 0`` (empty Dirichlet
+    shards under ``max_shard_size`` padding return the broadcast params
+    bit-identical) are excluded from the per-coordinate statistic so they
+    cannot vote the aggregate back toward the previous model. If the whole
+    cohort is zero-weight, the unmasked median is returned (every row IS
+    the broadcast model, so that median equals the previous model — the
+    correct stall).
     """
-    return jax.tree_util.tree_map(
-        # nanmedian: a poisoned client whose local training diverged to NaN
-        # must not poison the aggregate (jnp.median would propagate it).
-        lambda x: jnp.nanmedian(x.astype(jnp.float32), axis=0).astype(x.dtype),
-        stacked_tree,
-    )
-
-
-def trimmed_mean(stacked_tree, trim_ratio: float):
-    """Coordinate-wise trimmed mean: drop the k lowest and k highest values
-    per coordinate (k = floor(trim_ratio * n_clients)), average the rest.
-
-    Byzantine-robust for up to k adversarial clients. ``trim_ratio`` is
-    static (part of the compiled program).
-    """
+    valid = None
+    if weights is not None:
+        valid = jnp.asarray(weights, jnp.float32) > 0
+        # All-zero-weight cohort: treat every client as valid so the single
+        # statistic below degrades to the unmasked median (one nanmedian per
+        # leaf either way — both jnp.where branches would execute under jit,
+        # doubling the sort cost of every robust round).
+        valid = valid | ~jnp.any(valid)
 
     def _leaf(x):
-        n = x.shape[0]
-        k = int(trim_ratio * n)
-        if 2 * k >= n:
-            raise ValueError(
-                f"trim_ratio {trim_ratio} removes all {n} clients"
-            )
-        # jnp.sort places NaNs last, so for k >= 1 up to k NaN uploads land
-        # in the trimmed top-k; with k == 0 this is a plain mean and offers
-        # no robustness (NaN included).
-        s = jnp.sort(x.astype(jnp.float32), axis=0)
-        kept = s[k : n - k] if k else s
-        return jnp.mean(kept, axis=0).astype(x.dtype)
+        # nanmedian: a poisoned client whose local training diverged to NaN
+        # must not poison the aggregate (jnp.median would propagate it).
+        xf = x.astype(jnp.float32)
+        if valid is not None:
+            vshape = (-1,) + (1,) * (x.ndim - 1)
+            xf = jnp.where(valid.reshape(vshape), xf, jnp.nan)
+        return jnp.nanmedian(xf, axis=0).astype(x.dtype)
 
     return jax.tree_util.tree_map(_leaf, stacked_tree)
+
+
+def trimmed_mean(stacked_tree, trim_ratio: float, weights=None):
+    """Coordinate-wise trimmed mean: drop the k lowest and k highest values
+    per coordinate (k = floor(trim_ratio * m), m = participating clients),
+    average the rest.
+
+    Byzantine-robust for up to k adversarial clients. ``trim_ratio`` is
+    static (part of the compiled program). Like :func:`coordinate_median`,
+    ``weights`` act as a participation mask: zero-weight clients are
+    excluded from the per-coordinate order statistic (they are bit-identical
+    copies of the broadcast model, not updates); with an all-zero cohort the
+    unmasked statistic is returned. NaN uploads sort into the trimmed top
+    region as long as the per-coordinate NaN count stays <= k; beyond that
+    the result goes NaN and the round-level finite-or-previous fallback
+    engages.
+    """
+    n_total = jax.tree_util.tree_leaves(stacked_tree)[0].shape[0]
+    if not 0.0 <= trim_ratio < 0.5:
+        # trim_ratio < 0.5 also guarantees m - 2k >= 1 for any participating
+        # count m >= 1 in the weighted path below (k = floor(trim_ratio*m)),
+        # so no runtime empty-window case exists past this check.
+        raise ValueError(
+            f"trim_ratio {trim_ratio} removes all {n_total} clients"
+        )
+    if weights is None:
+
+        def _leaf(x):
+            n = x.shape[0]
+            k = int(trim_ratio * n)
+            s = jnp.sort(x.astype(jnp.float32), axis=0)
+            kept = s[k : n - k] if k else s
+            return jnp.mean(kept, axis=0).astype(x.dtype)
+
+        return jax.tree_util.tree_map(_leaf, stacked_tree)
+
+    valid = jnp.asarray(weights, jnp.float32) > 0
+    # All-zero-weight cohort: treat every client as valid — the statistic
+    # degrades to the unmasked trimmed mean with one sort per leaf (a
+    # second jnp.where branch would double the sort cost of every round).
+    valid = valid | ~jnp.any(valid)
+    m = jnp.sum(valid.astype(jnp.int32))
+    k = jnp.floor(trim_ratio * m).astype(jnp.int32)
+
+    def _leaf_w(x):
+        n = x.shape[0]
+        xf = x.astype(jnp.float32)
+        vshape = (-1,) + (1,) * (x.ndim - 1)
+        idx = jnp.arange(n).reshape(vshape)
+        masked = jnp.where(valid.reshape(vshape), xf, jnp.nan)
+        s = jnp.sort(masked, axis=0)  # valid values first, NaN rows last
+        keep = (idx >= k) & (idx < m - k)
+        kept_sum = jnp.sum(jnp.where(keep, s, 0.0), axis=0)
+        return (kept_sum / (m - 2 * k)).astype(x.dtype)
+
+    return jax.tree_util.tree_map(_leaf_w, stacked_tree)
 
 
 def krum(stacked_tree, n_byzantine: int = 0, weights=None):
@@ -168,9 +216,9 @@ def aggregate(stacked_tree, weights, rule: str, trim_ratio: float = 0.1):
     """
     rule = rule.lower()
     if rule == "median":
-        return coordinate_median(stacked_tree)
+        return coordinate_median(stacked_tree, weights=weights)
     if rule == "trimmed_mean":
-        return trimmed_mean(stacked_tree, trim_ratio)
+        return trimmed_mean(stacked_tree, trim_ratio, weights=weights)
     if rule == "krum":
         n = jax.tree_util.tree_leaves(stacked_tree)[0].shape[0]
         return krum(stacked_tree, n_byzantine=int(trim_ratio * n),

@@ -58,8 +58,8 @@ from distributed_learning_simulator_tpu.utils.checkpoint import (
 )
 from distributed_learning_simulator_tpu.utils.logging import (
     get_logger,
-    set_file_handler,
     set_level,
+    set_run_artifacts,
 )
 from distributed_learning_simulator_tpu.utils.tracing import (
     annotate,
@@ -164,18 +164,9 @@ def run_simulation(
     and pass it in.
     """
     config.validate()
-    if config.execution_mode.lower() == "threaded":
-        # Honor the flag from EVERY entry point (heterogeneous CLI, bench,
-        # programmatic callers), not just simulator.main.
-        from distributed_learning_simulator_tpu.execution.threaded import (
-            run_threaded_simulation,
-        )
-
-        return run_threaded_simulation(
-            config, dataset=dataset, client_data=client_data
-        )
-    logger = get_logger()
-    set_level(config.log_level)
+    # Compilation-cache config comes BEFORE the execution-mode dispatch so
+    # threaded runs (whose per-client local_train is jitted too) get the
+    # persistent cache as well.
     if config.compilation_cache_dir:
         jax.config.update(
             "jax_compilation_cache_dir", config.compilation_cache_dir
@@ -186,15 +177,27 @@ def run_simulation(
         # earlier run in this process doesn't leak into a run that asked
         # for no caching.
         jax.config.update("jax_compilation_cache_dir", None)
+    if config.execution_mode.lower() == "threaded":
+        # Honor the flag from EVERY entry point (heterogeneous CLI, bench,
+        # programmatic callers), not just simulator.main.
+        from distributed_learning_simulator_tpu.execution.threaded import (
+            run_threaded_simulation,
+        )
+
+        return run_threaded_simulation(
+            config, dataset=dataset, client_data=client_data,
+            setup_logging=setup_logging,
+        )
+    logger = get_logger()
+    set_level(config.log_level)
     log_dir = None
     if setup_logging:
-        log_path = set_file_handler(
+        # Per-run artifact dir: Shapley metric pickles etc. go here so
+        # concurrent/subsequent runs never overwrite each other's artifacts.
+        log_path, log_dir = set_run_artifacts(
             config.log_root, config.distributed_algorithm,
             config.dataset_name, config.model_name,
         )
-        # Per-run artifact dir: Shapley metric pickles etc. go here so
-        # concurrent/subsequent runs never overwrite each other's artifacts.
-        log_dir = log_path[: -len(".log")] + "_artifacts"
         logger.info("log file: %s", log_path)
 
     # --- data ---------------------------------------------------------------
@@ -341,7 +344,6 @@ def run_simulation(
     history: list[dict] = []
     metrics_path = None
     if log_dir:
-        os.makedirs(log_dir, exist_ok=True)
         metrics_path = os.path.join(log_dir, "metrics.jsonl")
 
     # Pipelined mode defers each round's device->host metric fetch until the

@@ -44,12 +44,34 @@ def set_file_handler(
     log_dir = os.path.join(log_root, algorithm, dataset, model)
     os.makedirs(log_dir, exist_ok=True)
     path = os.path.join(log_dir, f"{int(ts)}.log")
+    logger = get_logger()
+    # One file sink per run: detach the previous run's handler (else a
+    # long-lived process fans every later run's lines into all earlier
+    # runs' files and leaks descriptors).
+    for h in [h for h in logger.handlers if isinstance(h, logging.FileHandler)]:
+        logger.removeHandler(h)
+        h.close()
     handler = logging.FileHandler(path)
     handler.setFormatter(
         logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     )
-    get_logger().addHandler(handler)
+    logger.addHandler(handler)
     return path
+
+
+def set_run_artifacts(
+    log_root: str, algorithm: str, dataset: str, model: str
+) -> tuple[str, str]:
+    """Attach the per-run file sink and create the per-run artifacts dir.
+
+    Returns ``(log_path, artifacts_dir)``. Single source of the per-run
+    layout (``<ts>.log`` + ``<ts>_artifacts/`` with ``metrics.jsonl``,
+    Shapley pickles, ...) shared by the vmap and threaded execution paths.
+    """
+    path = set_file_handler(log_root, algorithm, dataset, model)
+    artifacts_dir = path[: -len(".log")] + "_artifacts"
+    os.makedirs(artifacts_dir, exist_ok=True)
+    return path, artifacts_dir
 
 
 def set_level(level: str) -> None:

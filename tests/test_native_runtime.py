@@ -140,7 +140,7 @@ def test_threaded_simulation_learns(tiny_config):
     )
 
     cfg = dataclasses.replace(tiny_config, round=3)
-    res = run_threaded_simulation(cfg)
+    res = run_threaded_simulation(cfg, setup_logging=False)
     assert len(res["history"]) == 3
     accs = [h["test_accuracy"] for h in res["history"]]
     assert accs[-1] > 0.2
@@ -154,7 +154,7 @@ def test_threaded_median_aggregation(tiny_config):
     )
 
     cfg = dataclasses.replace(tiny_config, round=2, aggregation="median")
-    res = run_threaded_simulation(cfg)
+    res = run_threaded_simulation(cfg, setup_logging=False)
     import numpy as np
 
     assert all(np.isfinite(h["test_loss"]) for h in res["history"])

@@ -184,3 +184,101 @@ def test_shapley_rejects_robust_aggregation(tiny_config):
             ),
             setup_logging=False,
         )
+
+
+def test_median_excludes_zero_weight_clients():
+    """Empty-shard clients (weight 0) return the broadcast params
+    bit-identical; a majority of them must not vote the median back to the
+    previous model (ADVICE r1 #3)."""
+    honest = np.random.default_rng(6).normal(1.0, 0.01, size=(3, 4))
+    stale = np.zeros((5, 4))  # five zero-sample copies of the broadcast
+    stack = {"w": jnp.asarray(np.concatenate([honest, stale]), jnp.float32)}
+    weights = np.array([10.0, 10.0, 10.0, 0, 0, 0, 0, 0])
+    out = np.asarray(coordinate_median(stack, weights=weights)["w"])
+    assert np.abs(out - 1.0).max() < 0.05  # honest median, not the stale 0s
+    # Unweighted call keeps the old behavior (stale majority wins).
+    out_u = np.asarray(coordinate_median(stack)["w"])
+    assert np.abs(out_u).max() < 0.05
+
+
+def test_trimmed_mean_excludes_zero_weight_clients():
+    honest = np.random.default_rng(7).normal(1.0, 0.01, size=(5, 4))
+    stale = np.zeros((5, 4))
+    stack = {"w": jnp.asarray(np.concatenate([honest, stale]), jnp.float32)}
+    weights = np.concatenate([np.full(5, 10.0), np.zeros(5)])
+    out = np.asarray(trimmed_mean(stack, 0.2, weights=weights)["w"])
+    # k = floor(0.2*5) = 1: mean of the middle 3 honest clients.
+    s = np.sort(honest, axis=0)
+    np.testing.assert_allclose(out, s[1:-1].mean(axis=0), rtol=1e-5)
+
+
+def test_trimmed_mean_weighted_matches_unweighted_when_all_valid():
+    x = np.random.default_rng(8).normal(size=(10, 6)).astype(np.float32)
+    stack = {"w": jnp.asarray(x)}
+    out_u = np.asarray(trimmed_mean(stack, 0.2)["w"])
+    out_w = np.asarray(trimmed_mean(stack, 0.2, weights=np.ones(10))["w"])
+    np.testing.assert_allclose(out_w, out_u, rtol=1e-5)
+
+
+def test_weighted_robust_rules_all_zero_cohort_stall():
+    """All-zero-weight cohort: every row is the identical broadcast model,
+    and the masked statistic must degrade to exactly that model (the
+    correct stall), not zeros or NaN."""
+    bcast = np.full((6, 4), 0.7, np.float32)
+    stack = {"w": jnp.asarray(bcast)}
+    weights = np.zeros(6)
+    med = np.asarray(coordinate_median(stack, weights=weights)["w"])
+    tm = np.asarray(trimmed_mean(stack, 0.1, weights=weights)["w"])
+    np.testing.assert_allclose(med, 0.7, rtol=1e-6)
+    np.testing.assert_allclose(tm, 0.7, rtol=1e-6)
+
+
+def test_trimmed_mean_weighted_nan_poison_propagates_when_k_zero_effective():
+    """With more NaN uploads than k among the valid clients, the statistic
+    goes NaN (round-level fallback then keeps the previous model)."""
+    honest = np.random.default_rng(9).normal(1.0, 0.01, size=(4, 3))
+    poison = np.full((2, 3), np.nan)
+    stack = {"w": jnp.asarray(np.concatenate([honest, poison]), jnp.float32)}
+    weights = np.ones(6)  # k = floor(0.1*6) = 0 < 2 NaN rows
+    out = np.asarray(trimmed_mean(stack, 0.1, weights=weights)["w"])
+    assert np.isnan(out).all()
+
+
+def test_trimmed_mean_infeasible_config_fails_fast(tiny_config):
+    """k = floor(trim_ratio * cohort) == 0 is a plain mean with zero
+    robustness; validate() must reject it (ADVICE r1 #1)."""
+    with pytest.raises(ValueError, match="trim_ratio \\* cohort"):
+        dataclasses.replace(
+            tiny_config, aggregation="trimmed_mean", worker_number=8,
+            trim_ratio=0.1,
+        ).validate()
+    # Feasible once the cohort is large enough for one trim.
+    dataclasses.replace(
+        tiny_config, aggregation="trimmed_mean", worker_number=10,
+        trim_ratio=0.1,
+    ).validate()
+
+
+def test_threaded_robust_fallback_matches_vmap(tiny_config):
+    """ThreadedServer must apply the same finite-or-previous-model guard as
+    the vmap round (ADVICE r1 #2): an all-diverged cohort keeps the
+    previous global model."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        ThreadedServer,
+    )
+
+    cfg = dataclasses.replace(tiny_config, worker_number=2,
+                              aggregation="median")
+    prev = {"w": jnp.asarray(np.full((3,), 0.5, np.float32))}
+    server = ThreadedServer(
+        cfg, lambda p, *b: {"accuracy": 0.0, "loss": 0.0}, (), prev
+    )
+    try:
+        nan_params = {"w": np.full((3,), np.nan, np.float32)}
+        server._process_worker_data((0, 1.0, nan_params), None)
+        server._process_worker_data((1, 1.0, nan_params), None)
+        np.testing.assert_array_equal(
+            np.asarray(server.prev_model["w"]), np.asarray(prev["w"])
+        )
+    finally:
+        server.stop()

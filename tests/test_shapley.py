@@ -70,3 +70,53 @@ def test_exact_refuses_large_n(tiny_config):
     )
     with pytest.raises(ValueError, match="2\\^N"):
         algo.post_round(ctx)
+
+
+def test_gtg_convergence_is_distance_to_final(tiny_config):
+    """Reference formula (GTG_shapley_value_server.py:82-91): each of the
+    last_k running means is compared to the FINAL running mean, not to its
+    successor. A running mean drifting steadily — small per-step change,
+    large cumulative distance — must NOT converge (a diff-based test would
+    stop sampling here; this input is where the two formulas disagree)."""
+    from distributed_learning_simulator_tpu.algorithms.shapley import GTGShapley
+
+    tiny_config.gtg_last_k = 10
+    tiny_config.gtg_converge_criteria = 0.05
+    algo = GTGShapley(tiny_config)
+
+    # Build records whose running means are constant [3, 3] for the first
+    # 31 samples, then drift down by 0.04 per sample for 10 samples.
+    means = [np.array([3.0, 3.0])] * 31
+    for step in range(1, 11):
+        means.append(np.array([3.0 - 0.04 * step] * 2))
+    records = []
+    for t, m in enumerate(means, start=1):
+        prev = means[t - 2] if t > 1 else np.zeros(2)
+        records.append(t * m - (t - 1) * prev)
+    running = np.cumsum(np.stack(records), 0) / np.arange(1, 42)[:, None]
+    np.testing.assert_allclose(running, np.stack(means), rtol=1e-12)
+
+    # Per-step relative change is ~0.0154 (< 0.05): a successive-diff test
+    # would declare convergence...
+    recent = running[-11:]
+    per_step = np.mean(
+        np.abs(np.diff(recent, axis=0)) / (np.abs(recent[-1]) + 1e-12), axis=1
+    )
+    assert per_step.max() < 0.05
+    # ...but the distance of the oldest of the last 10 running means to the
+    # final one is ~0.138 (> 0.05), so the reference keeps sampling.
+    assert algo._converged(records, n=2) is False
+
+    # Once the running mean actually flattens, it converges.
+    flat = records + [means[-1]] * 15
+    assert algo._converged(flat, n=2) is True
+
+
+def test_gtg_convergence_respects_converge_min(tiny_config):
+    """index <= max(30, n) never converges (GTG_shapley_value_server.py:15)."""
+    from distributed_learning_simulator_tpu.algorithms.shapley import GTGShapley
+
+    algo = GTGShapley(tiny_config)
+    records = [np.ones(2)] * 30  # perfectly flat, but too few samples
+    assert algo._converged(records, n=2) is False
+    assert algo._converged(records + [np.ones(2)], n=2) is True
