@@ -63,9 +63,14 @@ def main():
                             setup_logging=False)
     # Steady-state rate: drop round 0 (jit compile of the round + eval
     # programs happens there, inside the same jitted callables the later
-    # rounds reuse).
+    # rounds reuse). Wall-clock including compile is reported alongside so
+    # the steady-state claim is auditable (VERDICT r1 weak #7).
     steady = [h["round_seconds"] for h in result["history"][1:]]
     elapsed = sum(steady)
+    total_wall = result["total_seconds"]
+    compile_s = result["history"][0]["round_seconds"] - (
+        elapsed / max(len(steady), 1)
+    )
 
     value = n_clients * n_rounds / elapsed
     north_star = 1000 * 100 / 300.0  # 333.3 clients*rounds/sec on v5e-8
@@ -77,6 +82,11 @@ def main():
         "clients": n_clients,
         "rounds": n_rounds,
         "elapsed_s": round(elapsed, 2),
+        "total_wall_s": round(total_wall, 2),
+        "compile_s": round(max(compile_s, 0.0), 2),
+        "wall_clients_x_rounds_per_sec": round(
+            n_clients * (n_rounds + 1) / total_wall, 2
+        ),
         "final_accuracy": result["final_accuracy"],
     }))
 

@@ -238,7 +238,11 @@ class GTGShapley(FedAvg):
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
         converge_min = max(30, n)  # GTG_shapley_value_server.py:15
-        if len(records) <= converge_min:
+        # last_k + 1 records minimum: with a configurable last_k above the
+        # reference's 30-record floor, running_means[-last_k:] would silently
+        # truncate and a mean flat over fewer samples than the user asked to
+        # compare could fire convergence early.
+        if len(records) <= max(converge_min, self.last_k):
             return False
         # Reference semantics (GTG_shapley_value_server.py:82-91): each of
         # the last_k running means is compared to the FINAL running mean —

@@ -78,6 +78,15 @@ class ExperimentConfig:
     # --- quantization (algorithms/fed_quant.py) ----------------------------
     quant_levels: int = 256
     qat: bool = True
+    # Per-round per-client local evaluation (fed_quant only): every client's
+    # uploaded model is evaluated on the test set BEFORE aggregation, with
+    # the post-aggregation global accuracy logged alongside — parity with
+    # reference workers/fed_quant_worker.py:55-69. Requires materializing
+    # the per-client parameter stack (the fused memory-bounded aggregation
+    # path can't serve it), so None = auto: on for cohorts <= 32 (the
+    # reference ran 4-8 workers), off above, preserving the large-cohort
+    # memory envelope. Explicit True/False overrides.
+    client_eval: bool | None = None
 
     # --- Shapley (algorithms/shapley.py) ------------------------------------
     round_trunc_threshold: float | None = None
@@ -94,6 +103,17 @@ class ExperimentConfig:
     # architecture parity and as a differential-testing oracle.
     execution_mode: str = "vmap"
     mesh_devices: int | None = None  # None = single-device vmap path
+    # Multi-host (DCN): initialize jax.distributed before device discovery so
+    # jax.devices() spans every host's chips and the same mesh/sharding code
+    # runs the client axis over ICI within a slice and DCN across slices.
+    # Replaces the reference's dormant multi-process path
+    # (servers/server.py:11-13, hard-disabled at simulator.py:56). With only
+    # --multihost set, relies on the Cloud TPU pod auto-configuration; the
+    # explicit coordinator flags cover CPU/GPU clusters and tests.
+    multihost: bool = False
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
     # Max clients trained concurrently inside one round program. None = all
     # at once (pure vmap). At large N the per-client params/grads/momentum
     # copies and activations exceed HBM; chunking runs vmap-ed chunks
@@ -208,10 +228,21 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
         if f.type in ("bool", bool):
             parser.add_argument(arg, type=lambda s: s.lower() in ("1", "true"),
                                 default=f.default)
-        elif f.name in ("n_train", "n_test", "mesh_devices"):
+        elif f.name == "client_eval":  # tri-state: auto/None, true, false
+            parser.add_argument(
+                arg,
+                type=lambda s: (
+                    None if s.lower() in ("auto", "none")
+                    else s.lower() in ("1", "true")
+                ),
+                default=None,
+            )
+        elif f.name in ("n_train", "n_test", "mesh_devices", "num_processes",
+                        "process_id"):
             parser.add_argument(arg, type=int, default=None)
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
-                        "profile_dir", "client_chunk_size", "max_shard_size"):
+                        "profile_dir", "client_chunk_size", "max_shard_size",
+                        "coordinator_address"):
             typ = {
                 "round_trunc_threshold": float,
                 "client_chunk_size": int,

@@ -23,19 +23,37 @@ def initialize_multihost(
     """Initialize jax.distributed; returns the global device count.
 
     With no arguments, relies on the TPU environment's auto-configuration
-    (the standard path on Cloud TPU pods). Safe to call when already
-    initialized (returns immediately).
+    (the standard path on Cloud TPU pods); in a plain single-process
+    environment that raises (nothing to auto-detect) and degrades to a
+    logged no-op, so one binary serves pods and laptops. With EXPLICIT
+    coordinator flags, failures are fatal: a misconfigured 2-process launch
+    must not silently split into two independent single-process runs that
+    each write a full set of artifacts.
     """
     logger = get_logger()
+    explicit = any(
+        v is not None
+        for v in (coordinator_address, num_processes, process_id)
+    )
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError as e:
-        # Already initialized, or single-process environment.
-        logger.info("jax.distributed.initialize skipped: %s", e)
+    except (RuntimeError, ValueError) as e:
+        # RuntimeError: already initialized; ValueError: no coordinator
+        # configured and none auto-detectable (single-process environment).
+        if explicit:
+            raise RuntimeError(
+                "jax.distributed.initialize failed with explicit multihost "
+                f"flags (coordinator_address={coordinator_address!r}, "
+                f"num_processes={num_processes}, process_id={process_id}); "
+                "refusing to degrade to a single-process run"
+            ) from e
+        logger.info(
+            "jax.distributed.initialize skipped (single process): %s", e
+        )
     n = len(jax.devices())
     logger.info(
         "multihost: process %d/%d, %d global devices",

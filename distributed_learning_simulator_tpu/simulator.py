@@ -164,6 +164,18 @@ def run_simulation(
     and pass it in.
     """
     config.validate()
+    if config.multihost:
+        # Before ANY device query or dispatch: jax.distributed must come up
+        # first so the default backend enumerates every host's devices.
+        from distributed_learning_simulator_tpu.parallel.multihost import (
+            initialize_multihost,
+        )
+
+        initialize_multihost(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
     # Compilation-cache config comes BEFORE the execution-mode dispatch so
     # threaded runs (whose per-client local_train is jitted too) get the
     # persistent cache as well.

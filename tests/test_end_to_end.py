@@ -110,6 +110,46 @@ def test_fed_quant_learns_and_reports_compression(tiny_config):
     assert 3.5 < last["uplink_compression_ratio"] < 4.1  # fp32 -> 8-bit
 
 
+def test_fed_quant_client_eval_telemetry(tiny_config):
+    """Per-round pre/post-aggregation accuracy telemetry (parity with
+    reference fed_quant_worker.py:55-69, batched under vmap here)."""
+    res = _run(tiny_config, distributed_algorithm="fed_quant", round=3)
+    for h in res["history"]:
+        ce = h["client_eval"]
+        assert 0.0 <= ce["pre_agg_accuracy_min"] <= ce["pre_agg_accuracy_mean"]
+        assert ce["pre_agg_accuracy_mean"] <= ce["pre_agg_accuracy_max"] <= 1.0
+        assert ce["post_agg_accuracy"] == h["test_accuracy"]
+    # clients train on disjoint shards with per-client RNG, so their local
+    # models must not collapse to one evaluator (catches a vmap in_axes bug
+    # broadcasting a single params tree); deterministic under the fixed seed
+    last = res["history"][-1]["client_eval"]
+    assert last["pre_agg_accuracy_max"] > last["pre_agg_accuracy_min"]
+
+
+def test_fed_quant_client_eval_auto_disables_large_cohort(tiny_config):
+    """client_eval=None (auto) must keep the fused memory-bounded path for
+    large cohorts: no telemetry above the auto threshold."""
+    from distributed_learning_simulator_tpu.algorithms.fed_quant import FedQuant
+
+    big = dataclasses.replace(tiny_config, worker_number=64, client_eval=None)
+    assert FedQuant(big).keep_client_params is False
+    small = dataclasses.replace(tiny_config, worker_number=8, client_eval=None)
+    assert FedQuant(small).keep_client_params is True
+    forced = dataclasses.replace(tiny_config, worker_number=64,
+                                 client_eval=True)
+    assert FedQuant(forced).keep_client_params is True
+
+
+def test_fed_quant_client_eval_disabled(tiny_config):
+    """client_eval=False keeps the memory-safe fused path: no telemetry,
+    same compression reporting."""
+    res = _run(tiny_config, distributed_algorithm="fed_quant", round=2,
+               client_eval=False)
+    for h in res["history"]:
+        assert "client_eval" not in h
+        assert h["uplink_compression_ratio"] > 3.5
+
+
 def test_multiround_shapley(tiny_config):
     res = _run(tiny_config, distributed_algorithm="multiround_shapley_value",
                round=2)

@@ -1,0 +1,112 @@
+"""Multi-host (DCN) initialization: CLI wiring + jax.distributed smoke.
+
+The reference's closest analogue is the dormant multi-process queue path
+(reference servers/server.py:11-13, hard-disabled at simulator.py:56).
+Here the capability is live: ``--multihost`` brings up jax.distributed
+before device discovery, after which the ordinary mesh/sharding code spans
+every process's devices.
+"""
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+
+import textwrap
+
+from distributed_learning_simulator_tpu.config import get_config
+from distributed_learning_simulator_tpu.parallel.multihost import (
+    initialize_multihost,
+)
+from distributed_learning_simulator_tpu.simulator import run_simulation
+
+
+def test_single_process_noop_path():
+    """With no coordinator configured, initialization degrades to a logged
+    no-op and reports this process's devices."""
+    n = initialize_multihost()
+    assert n == len(__import__("jax").devices())
+
+
+def test_multihost_flag_reaches_simulation(tiny_config):
+    """--multihost routes through initialize_multihost before any device
+    query; in a single-process environment the run proceeds normally."""
+    cfg = dataclasses.replace(tiny_config, multihost=True, round=1)
+    res = run_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 1
+
+
+def test_multihost_cli_flags_parse():
+    cfg = get_config([
+        "--multihost", "true",
+        "--coordinator_address", "localhost:9999",
+        "--num_processes", "2",
+        "--process_id", "0",
+    ])
+    assert cfg.multihost is True
+    assert cfg.coordinator_address == "localhost:9999"
+    assert cfg.num_processes == 2
+    assert cfg.process_id == 0
+
+
+def test_explicit_flags_make_failure_fatal():
+    """Explicit multi-process flags with a broken configuration must raise,
+    not silently degrade into an independent single-process run."""
+    import pytest
+
+    with pytest.raises(RuntimeError, match="refusing to degrade"):
+        # num_processes=2 without a coordinator address is unresolvable.
+        initialize_multihost(num_processes=2, process_id=0)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_WORKER_CODE = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_learning_simulator_tpu.parallel.multihost import (
+        initialize_multihost,
+    )
+    n = initialize_multihost(
+        coordinator_address=sys.argv[1],
+        num_processes=2,
+        process_id=int(sys.argv[2]),
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert n == 2, n  # one cpu device per process, both visible globally
+    # The mesh code needs no multihost-specific branch: a mesh over the
+    # global device list spans both processes.
+    from distributed_learning_simulator_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(2)
+    assert mesh.devices.shape == (2,)
+    print("MULTIHOST_OK", int(sys.argv[2]))
+""")
+
+
+def test_two_process_cpu_distributed_smoke():
+    """Real 2-process jax.distributed bring-up over localhost: the actual
+    DCN code path (coordinator service + global device enumeration), on the
+    CPU backend."""
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER_CODE, addr, str(i)],
+            cwd=repo, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=240) for p in procs]
+    for i, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (i, out, err)
+        assert f"MULTIHOST_OK {i}" in out, (i, out, err)
