@@ -11,7 +11,10 @@ bench's rate against the FULL 333.3 pod-rate even when running on a single
 chip (so >1.0 on one chip means the pod target is beaten 8x over).
 
 Prints ONE JSON line. Env overrides: BENCH_CLIENTS, BENCH_ROUNDS,
-BENCH_MODEL, BENCH_BATCH.
+BENCH_MODEL, BENCH_BATCH, BENCH_CHUNK (client_chunk_size), BENCH_DTYPE
+(local_compute_dtype). The flagship large-model configuration that hits
+the pod-rate on one chip (docs/PERFORMANCE.md):
+BENCH_MODEL=resnet18 BENCH_CHUNK=40 BENCH_DTYPE=bfloat16.
 """
 
 from __future__ import annotations
@@ -38,6 +41,9 @@ def main():
     # steps per local epoch with zero padding waste.
     batch = int(os.environ.get("BENCH_BATCH", "25"))
     chunk = int(os.environ.get("BENCH_CHUNK", "250"))
+    # Per-client local-state dtype (see config.local_compute_dtype): bf16
+    # halves the dominant HBM traffic at ResNet scale; f32 default.
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
 
     config = ExperimentConfig(
         dataset_name="cifar10",
@@ -55,6 +61,7 @@ def main():
         # forward needs (measured 19ms vs 28-34ms per round on one chip).
         eval_batch_size=10000,
         client_chunk_size=chunk,
+        local_compute_dtype=dtype,
     )
     dataset = get_dataset(config.dataset_name, seed=config.seed)
     client_data = build_client_data(config, dataset)

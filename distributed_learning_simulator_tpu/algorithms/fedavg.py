@@ -45,6 +45,9 @@ class FedAvg(Algorithm):
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 
         cfg = self.config
+        compute_dtype = None
+        if getattr(cfg, "local_compute_dtype", "float32") == "bfloat16":
+            compute_dtype = jnp.bfloat16
         local_train = make_local_train_fn(
             apply_fn,
             optimizer,
@@ -54,6 +57,7 @@ class FedAvg(Algorithm):
             reset_optimizer=cfg.reset_client_optimizer,
             preprocess=preprocess,
             augment=get_augment(cfg.augment),
+            compute_dtype=compute_dtype,
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
         keep = self.keep_client_params
@@ -94,10 +98,16 @@ class FedAvg(Algorithm):
 
             def reduce_chunk(cp, w, pk):
                 cp, _ = self.process_client_payload(cp, pk)
+                # Weighted partial sum accumulated in f32 even when client
+                # params are bf16 (local_compute_dtype): a sum over up to
+                # 1000 small weighted terms must not round at 8 bits of
+                # mantissa. The MXU takes bf16 inputs with an f32
+                # accumulator natively.
                 return jax.tree_util.tree_map(
                     lambda p: jnp.tensordot(
-                        w.astype(p.dtype), p, axes=(0, 0)
-                    ).astype(p.dtype),
+                        w.astype(jnp.float32), p, axes=(0, 0),
+                        preferred_element_type=jnp.float32,
+                    ),
                     cp,
                 )
 
@@ -170,6 +180,13 @@ class FedAvg(Algorithm):
                 client_params, new_state_k, train_metrics = train_clients(
                     global_params, state_k, x_k, y_k, m_k, client_keys
                 )
+                if compute_dtype is not None:
+                    # Robust rules / Shapley consume the full stack; restore
+                    # f32 so their statistics don't run at 8-bit mantissa
+                    # (materializing cohorts are small by construction).
+                    client_params = jax.tree_util.tree_map(
+                        lambda p: p.astype(jnp.float32), client_params
+                    )
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )

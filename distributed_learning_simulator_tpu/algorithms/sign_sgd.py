@@ -29,7 +29,13 @@ import jax
 import jax.numpy as jnp
 
 from distributed_learning_simulator_tpu.algorithms.base import Algorithm
-from distributed_learning_simulator_tpu.ops.sign import majority_vote, sign_compress
+from distributed_learning_simulator_tpu.ops.sign import (
+    direction_leaf,
+    majority_vote,
+    momentum_leaf,
+    sign_compress,
+    vote_apply_leaf,
+)
 from distributed_learning_simulator_tpu.parallel.engine import make_loss_fn
 
 
@@ -56,6 +62,13 @@ class SignSGD(Algorithm):
             raise ValueError(
                 "sign_SGD aggregates by sign majority vote; set "
                 "aggregation='mean'"
+            )
+        if getattr(config, "local_compute_dtype", "float32") != "float32":
+            # sign_SGD keeps ONE shared params tree (no per-client diverged
+            # state to compress); reject rather than silently ignore.
+            raise ValueError(
+                "sign_SGD does not use local_compute_dtype; set it to "
+                "'float32'"
             )
 
     def init_client_state(self, optimizer, global_params, n_clients):
@@ -108,31 +121,27 @@ class SignSGD(Algorithm):
                     (losses, _), grads = jax.vmap(
                         grad_fn, in_axes=(None, 0, 0, 0)
                     )(params, bx, by, bm)
-                    # torch-SGD momentum math (sign_sgd_worker.py:22-42): the
-                    # very first step initializes buf to the raw gradient
-                    # (torch's buf-is-None branch); later steps apply
-                    # mu*buf + (1-dampening)*grad.
+                    # torch-SGD step math: ops/sign.py leaf formulas, the
+                    # single source shared with the threaded oracle.
                     is_first = step_counts == 0  # [C]
 
-                    def momentum_leaf(m, g):
-                        cond = is_first.reshape((-1,) + (1,) * (g.ndim - 1))
-                        return jnp.where(cond, g, mu * m + (1.0 - dampening) * g)
-
                     momenta_new = jax.tree_util.tree_map(
-                        momentum_leaf, momenta, grads
+                        lambda m, g: momentum_leaf(
+                            m, g,
+                            is_first.reshape((-1,) + (1,) * (g.ndim - 1)),
+                            mu, dampening,
+                        ),
+                        momenta, grads,
                     )
-                    if nesterov:
-                        direction = jax.tree_util.tree_map(
-                            lambda g, m: g + mu * m, grads, momenta_new
-                        )
-                    else:
-                        direction = momenta_new
+                    direction = jax.tree_util.tree_map(
+                        lambda g, m: direction_leaf(g, m, mu, nesterov),
+                        grads, momenta_new,
+                    )
                     # sign -> sum over clients -> sign: the majority vote.
                     voted = majority_vote(sign_compress(direction))
-                    # Local apply: weight decay + lr * voted sign
-                    # (sign_sgd_worker.py:47-58).
                     params = jax.tree_util.tree_map(
-                        lambda p, v: p - lr * (v + wd * p), params, voted
+                        lambda p, v: vote_apply_leaf(p, v, lr, wd),
+                        params, voted,
                     )
                     return (params, momenta_new, step_counts + 1), jnp.mean(losses)
 

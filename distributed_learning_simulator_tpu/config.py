@@ -38,6 +38,18 @@ class ExperimentConfig:
     nesterov: bool = False
     seed: int = 0
     reset_client_optimizer: bool = True
+    # Dtype of the per-client DIVERGED params/grads/momenta during a local
+    # run (FedAvg family). "bfloat16" halves the round's dominant HBM
+    # traffic at large-model scale (per-client state is ~3x param bytes per
+    # in-flight client); the f32 global model remains the broadcast source
+    # every round, aggregation accumulates in f32, and every bf16 cast and
+    # param store uses hash-dither stochastic rounding with a per-client
+    # salt (engine._sr_to_bf16 — plain round-to-nearest measurably stalls
+    # long-horizon training; docs/PERFORMANCE.md). Requires
+    # reset_client_optimizer=True (persistent f32 optimizer state would
+    # mix dtypes across rounds). Worth it for large models (ResNet-18:
+    # +9% round rate at f32-parity accuracy); off by default.
+    local_compute_dtype: str = "float32"
     # In-step data augmentation (ops/augment.py): "none" or "cifar"
     # (random flip + pad-4 random crop). Replaces the reference's external
     # dataset-transform hook (transform_dataset, SURVEY §2.4) with a pure
@@ -197,6 +209,20 @@ class ExperimentConfig:
                     f"assumed Byzantine f={f}); lower trim_ratio or raise "
                     "worker_number/participation_fraction"
                 )
+        if self.local_compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown local_compute_dtype {self.local_compute_dtype!r}; "
+                "known: float32, bfloat16"
+            )
+        if (
+            self.local_compute_dtype == "bfloat16"
+            and not self.reset_client_optimizer
+        ):
+            raise ValueError(
+                "local_compute_dtype='bfloat16' requires "
+                "reset_client_optimizer=True (persistent per-client "
+                "optimizer state is f32 and would mix dtypes across rounds)"
+            )
         if self.execution_mode.lower() not in ("vmap", "threaded"):
             raise ValueError(
                 f"unknown execution_mode {self.execution_mode!r}; known: "

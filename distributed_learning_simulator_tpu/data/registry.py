@@ -96,6 +96,26 @@ def _load_npz(path: str, name: str, num_classes: int) -> Dataset:
     return Dataset(name, x_train, y_train, x_test, y_test, num_classes)
 
 
+def _load_digits(name: str, seed: int) -> Dataset:
+    """REAL pixels with no network: scikit-learn's bundled handwritten-digits
+    set (1797 8x8 grayscale images, the UCI/NIST optdigits test subsample,
+    shipped inside sklearn itself). This is the offline container's genuine
+    real-data path — every other real dataset needs a download (see
+    scripts/fetch_datasets.py and docs/ACCURACY.md). Deterministic seeded
+    1500/297 train/test split; pixels rescaled from the 0-16 integer range
+    to [0, 1]."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.images / 16.0).astype(np.float32)[..., None]
+    y = d.target.astype(np.int32)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(y))
+    x, y = x[perm], y[perm]
+    n_tr = 1500
+    return Dataset(name, x[:n_tr], y[:n_tr], x[n_tr:], y[n_tr:], 10)
+
+
 def _to_grayscale(ds: Dataset) -> Dataset:
     def gray(x):
         if x.shape[-1] == 1:
@@ -121,14 +141,17 @@ def get_dataset(
     """Fetch a dataset by name.
 
     Names: ``mnist`` / ``cifar10`` / ``cifar100`` (local .npz or synthetic
-    surrogate) and ``synthetic`` (explicitly synthetic; accepts ``shape``,
-    ``num_classes``, ``difficulty``). ``n_train``/``n_test`` subsample for
-    fast tests. ``to_grayscale`` is the reference's ``dataset_args``
-    heterogeneity knob (simulator_backup.py:50).
+    surrogate), ``digits`` (REAL handwritten-digit pixels bundled with
+    scikit-learn — works fully offline), and ``synthetic`` (explicitly
+    synthetic; accepts ``shape``, ``num_classes``, ``difficulty``).
+    ``n_train``/``n_test`` subsample for fast tests. ``to_grayscale`` is the
+    reference's ``dataset_args`` heterogeneity knob (simulator_backup.py:50).
     """
     key = name.lower()
     data_dir = data_dir or os.environ.get("DLS_DATA_DIR", "/root/data")
-    if key == "synthetic":
+    if key == "digits":
+        ds = _load_digits(key, seed=seed)
+    elif key == "synthetic":
         shape = tuple(synthetic_kwargs.pop("shape", (8, 8, 1)))
         num_classes = synthetic_kwargs.pop("num_classes", 10)
         ds = _synthetic_classification(
@@ -152,7 +175,8 @@ def get_dataset(
             )
     else:
         raise ValueError(
-            f"unknown dataset {name!r}; known: {sorted(_SHAPES) + ['synthetic']}"
+            f"unknown dataset {name!r}; known: "
+            f"{sorted(_SHAPES) + ['digits', 'synthetic']}"
         )
     if n_train is not None:
         ds.x_train, ds.y_train = ds.x_train[:n_train], ds.y_train[:n_train]

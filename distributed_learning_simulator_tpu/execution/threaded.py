@@ -23,16 +23,27 @@ Structure mirrors the reference exactly:
     epochs via the SAME jitted local_train the vmap path uses (one
     compilation shared by every thread), and uploads
     ``(worker_id, dataset_size, params)`` (fed_worker.py:19-38).
+  * :class:`ThreadedSignSGDServer` / :class:`ThreadedSignSGDWorker` carry
+    the reference's finest-grained queue contract — per-OPTIMIZER-STEP
+    sign-gradient sync (sign_sgd_worker.py:44-47: submit signs, block for
+    the majority vote, apply locally) — with the reference's mis-wired vote
+    method fixed (SURVEY 2.1#13). Because every worker applies the same
+    voted update, all workers hold identical params after every step; the
+    server maintains its own replica by applying the votes too, which lets
+    it evaluate and record per-round metrics without extra message types.
 
-Rounds are synchronized at round granularity, exactly like FedWorker.
+Rounds are synchronized at round granularity for FedAvg, at step
+granularity for SignSGD — exactly like the reference workers.
 """
 
 from __future__ import annotations
 
 import time
+from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_learning_simulator_tpu.config import ExperimentConfig
 from distributed_learning_simulator_tpu.data.partition import ClientData
@@ -174,25 +185,177 @@ class ThreadedWorker:
             )
 
 
+class ThreadedSignSGDServer:
+    """Per-step majority-vote server (reference servers/sign_sgd_server.py,
+    with the vote actually wired to the queue callback — the reference's
+    name-mangled ``__worker`` is dead code, SURVEY 2.1#13).
+
+    Buffers each worker's per-step sign gradients; on the Nth arrival sums
+    elementwise and re-signs (sign_sgd_server.py:16-18), broadcasts the vote
+    N times, and applies the vote to its own params replica — valid because
+    every worker applies the identical update, so server and workers stay in
+    bitwise lockstep (same jitted apply). At round boundaries (every
+    ``steps_per_round`` votes) it evaluates the replica and records the
+    per-round history the differential-testing oracle compares."""
+
+    def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
+                 init_params_tree, apply_vote, steps_per_round: int,
+                 metrics_path: str | None = None):
+        self.config = config
+        self.worker_number = config.worker_number
+        self._evaluate = evaluate
+        self._eval_batches = eval_batches
+        self._apply_vote = apply_vote
+        self._steps_per_round = steps_per_round
+        self._buffer: dict[int, Any] = {}
+        self._step = 0
+        self.history: list[dict] = []
+        self.metrics_path = metrics_path
+        self.params = init_params_tree
+        self._round_t0 = time.perf_counter()
+        self.worker_data_queue = NativeTaskQueue(
+            worker_fun=self._process_worker_data
+        )
+        # No initial broadcast: the reference SignSGDServer extends the bare
+        # Server (no FedServer param seeding); workers start from the same
+        # deterministic init instead.
+
+    def _process_worker_data(self, data, extra_args):
+        del extra_args
+        worker_id, signs = data
+        self._buffer[worker_id] = signs
+        if len(self._buffer) < self.worker_number:
+            return None  # barrier: every step waits for all N workers
+        # Majority vote: elementwise sign of the summed signs.
+        voted = jax.tree_util.tree_map(
+            lambda *xs: np.sign(np.sum(np.stack(xs), axis=0)),
+            *[self._buffer[i] for i in range(self.worker_number)],
+        )
+        self._buffer.clear()
+        self.params = self._apply_vote(
+            self.params, jax.tree_util.tree_map(jnp.asarray, voted)
+        )
+        self._step += 1
+        if self._step % self._steps_per_round == 0:
+            round_idx = self._step // self._steps_per_round - 1
+            metrics = {
+                k: float(v)
+                for k, v in self._evaluate(
+                    self.params, *self._eval_batches
+                ).items()
+            }
+            from distributed_learning_simulator_tpu.ops.payload import (
+                compression_ratio,
+                payload_bytes,
+                sign_payload_bytes,
+            )
+
+            raw = payload_bytes(self.params)
+            record = {
+                "round": round_idx,
+                "test_accuracy": metrics["accuracy"],
+                "test_loss": metrics["loss"],
+                "round_seconds": time.perf_counter() - self._round_t0,
+                "uplink_compression_ratio": compression_ratio(
+                    raw, sign_payload_bytes(self.params)
+                ),
+                "sync_steps": self._steps_per_round,
+            }
+            self.history.append(record)
+            if self.metrics_path:
+                import json
+
+                with open(self.metrics_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            get_logger().info(
+                "threaded round %d: test_acc=%.4f test_loss=%.4f",
+                round_idx, metrics["accuracy"], metrics["loss"],
+            )
+            self._round_t0 = time.perf_counter()
+        return RepeatedResult(voted, self.worker_number)
+
+    def stop(self):
+        self.worker_data_queue.stop()
+
+
+class ThreadedSignSGDWorker:
+    """One SignSGD client on its own thread (reference
+    workers/sign_sgd_worker.py): per optimizer step, compute the effective
+    SGD direction (torch momentum math incl. buf=grad first step, :22-42),
+    sign it, submit, block for the vote, apply locally (:44-58)."""
+
+    def __init__(self, worker_id: int, queue: NativeTaskQueue, direction_fn,
+                 apply_vote, shard, init_params_tree, rounds: int,
+                 epochs: int, batch_size: int, seed: int):
+        self.worker_id = worker_id
+        self.queue = queue
+        self._direction = direction_fn
+        self._apply_vote = apply_vote
+        self._shard = shard  # (xs, ys, mask, size)
+        self._init_params = init_params_tree
+        self._rounds = rounds
+        self._epochs = epochs
+        self._batch_size = batch_size
+        self._seed = seed
+
+    def train(self):
+        xs, ys, mask, _size = self._shard
+        params = jax.tree_util.tree_map(jnp.asarray, self._init_params)
+        momenta = jax.tree_util.tree_map(jnp.zeros_like, params)
+        shard_size = xs.shape[0]
+        steps_per_epoch = shard_size // self._batch_size
+        rng = np.random.default_rng(self._seed * 100003 + self.worker_id)
+        is_first = True
+        for _ in range(self._rounds):
+            for _ in range(self._epochs):
+                perm = rng.permutation(shard_size)
+                for s in range(steps_per_epoch):
+                    idx = perm[s * self._batch_size:(s + 1) * self._batch_size]
+                    signs, momenta = self._direction(
+                        params, momenta, jnp.asarray(is_first),
+                        xs[idx], ys[idx], mask[idx],
+                    )
+                    is_first = False
+                    self.queue.add_task(
+                        (self.worker_id, jax.device_get(signs))
+                    )
+                    voted = self.queue.get_result()
+                    params = self._apply_vote(
+                        params, jax.tree_util.tree_map(jnp.asarray, voted)
+                    )
+
+
 def run_threaded_simulation(
     config: ExperimentConfig,
     dataset: Dataset | None = None,
     client_data: ClientData | None = None,
     setup_logging: bool = True,
 ):
-    """Run FedAvg in thread-per-client mode; returns a result dict.
+    """Run FedAvg or SignSGD in thread-per-client mode; returns a result
+    dict.
 
-    Semantically equivalent to ``run_simulation`` with algorithm="fed" and
-    reset_client_optimizer=True (client batch order differs, so trajectories
-    match statistically, not bitwise).
+    Semantically equivalent to ``run_simulation`` with the same algorithm
+    (client batch order differs, so trajectories match statistically, not
+    bitwise) — the two execution modes are a differential-testing oracle
+    pair.
     """
     from distributed_learning_simulator_tpu.simulator import build_client_data
 
     config.validate()
-    if config.distributed_algorithm != "fed":
+    algo_name = config.distributed_algorithm
+    if algo_name not in ("fed", "sign_SGD"):
         raise ValueError(
-            "threaded execution mode currently supports algorithm 'fed'"
+            "threaded execution mode supports algorithms 'fed' and "
+            f"'sign_SGD', not {algo_name!r}"
         )
+    if algo_name == "sign_SGD":
+        # Constructor runs the sign_SGD config validation (requires SGD,
+        # no augmentation, mean aggregation) — shared with the vmap path.
+        from distributed_learning_simulator_tpu.algorithms.sign_sgd import (
+            SignSGD,
+        )
+
+        SignSGD(config)
     if config.server_optimizer_name.lower() not in ("none", ""):
         raise ValueError(
             "threaded execution mode does not support server optimizers; "
@@ -252,16 +415,8 @@ def run_threaded_simulation(
     )
     from distributed_learning_simulator_tpu.ops.augment import get_augment
 
-    local_train = jax.jit(
-        make_local_train_fn(
-            model.apply, optimizer, local_epochs=config.epoch,
-            batch_size=config.batch_size, reset_optimizer=True,
-            preprocess=(
-                make_decoder(client_data.sample_shape)
-                if client_data.compact else None
-            ),
-            augment=get_augment(config.augment),
-        )
+    decoder = (
+        make_decoder(client_data.sample_shape) if client_data.compact else None
     )
     evaluate = jax.jit(make_eval_fn(model.apply))
     eval_batches = tuple(
@@ -272,8 +427,29 @@ def run_threaded_simulation(
     )
 
     t_start = time.perf_counter()
-    server = ThreadedServer(config, evaluate, eval_batches, params,
-                            metrics_path=metrics_path)
+    if algo_name == "sign_SGD":
+        server, make_worker = _build_sign_sgd(
+            config, model, params, evaluate, eval_batches, decoder,
+            client_data, metrics_path,
+        )
+    else:
+        local_train = jax.jit(
+            make_local_train_fn(
+                model.apply, optimizer, local_epochs=config.epoch,
+                batch_size=config.batch_size, reset_optimizer=True,
+                preprocess=decoder,
+                augment=get_augment(config.augment),
+            )
+        )
+        server = ThreadedServer(config, evaluate, eval_batches, params,
+                                metrics_path=metrics_path)
+
+        def make_worker(worker_id, shard):
+            return ThreadedWorker(
+                worker_id, server.worker_data_queue, local_train, shard,
+                config.round, config.seed,
+            )
+
     pool = NativeThreadPool(config.worker_number)
     try:
         for worker_id in range(client_data.n_clients):
@@ -283,11 +459,7 @@ def run_threaded_simulation(
                 jnp.asarray(client_data.mask[worker_id]),
                 float(client_data.sizes[worker_id]),
             )
-            worker = ThreadedWorker(
-                worker_id, server.worker_data_queue, local_train, shard,
-                config.round, config.seed,
-            )
-            pool.exec(worker.train)
+            pool.exec(make_worker(worker_id, shard).train)
         pool.join_pending()
         pool.results()  # re-raise any worker error
     finally:
@@ -296,10 +468,74 @@ def run_threaded_simulation(
     total = time.perf_counter() - t_start
     history = server.history
     n = client_data.n_clients
+    final_params = (
+        server.params if algo_name == "sign_SGD" else server.prev_model
+    )
     return {
-        "global_params": server.prev_model,
+        "global_params": final_params,
         "history": history,
         "final_accuracy": history[-1]["test_accuracy"] if history else None,
         "total_seconds": total,
         "client_rounds_per_sec": config.round * n / max(total, 1e-9),
     }
+
+
+def _build_sign_sgd(config, model, params, evaluate, eval_batches, decoder,
+                    client_data, metrics_path):
+    """Shared jitted step helpers + server/worker factory for the per-step
+    sign-vote mode. The step math comes from the ops/sign.py leaf formulas
+    — the single source shared with the vmap SignSGD (the two modes are a
+    differential oracle pair); apply is the same jitted closure on server
+    and workers so their param replicas stay in bitwise lockstep."""
+    from distributed_learning_simulator_tpu.ops.sign import (
+        direction_leaf,
+        momentum_leaf,
+        sign_compress,
+        vote_apply_leaf,
+    )
+    from distributed_learning_simulator_tpu.parallel.engine import make_loss_fn
+
+    lr = config.learning_rate
+    mu = config.momentum
+    dampening = config.dampening
+    nesterov = config.nesterov
+    wd = config.weight_decay
+    loss_fn = make_loss_fn(model.apply)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @jax.jit
+    def direction_fn(p, momenta, is_first, bx, by, bm):
+        if decoder is not None:
+            bx = decoder(bx)
+        (_, _), grads = grad_fn(p, bx, by, bm)
+        momenta_new = jax.tree_util.tree_map(
+            lambda m, g: momentum_leaf(m, g, is_first, mu, dampening),
+            momenta, grads,
+        )
+        direction = jax.tree_util.tree_map(
+            lambda g, m: direction_leaf(g, m, mu, nesterov),
+            grads, momenta_new,
+        )
+        return sign_compress(direction), momenta_new
+
+    @jax.jit
+    def apply_vote(p, voted):
+        return jax.tree_util.tree_map(
+            lambda pp, vv: vote_apply_leaf(pp, vv, lr, wd), p, voted
+        )
+
+    shard_size = client_data.x.shape[1]
+    steps_per_round = config.epoch * (shard_size // config.batch_size)
+    server = ThreadedSignSGDServer(
+        config, evaluate, eval_batches, params, apply_vote, steps_per_round,
+        metrics_path=metrics_path,
+    )
+
+    def make_worker(worker_id, shard):
+        return ThreadedSignSGDWorker(
+            worker_id, server.worker_data_queue, direction_fn, apply_vote,
+            shard, params, config.round, config.epoch, config.batch_size,
+            config.seed,
+        )
+
+    return server, make_worker

@@ -33,3 +33,30 @@ def majority_vote(stacked_sign_tree):
     return jax.tree_util.tree_map(
         lambda x: jnp.sign(jnp.sum(x, axis=0)), stacked_sign_tree
     )
+
+
+# ---- torch-SGD step math, single source -----------------------------------
+# The vmap round program (algorithms/sign_sgd.py) and the thread-per-client
+# mode (execution/threaded.py) are a differential-testing oracle pair: both
+# must implement EXACTLY the reference worker's update math
+# (sign_sgd_worker.py:22-42 momentum, :47-58 apply). These leaf-level
+# formulas are the one copy both consume.
+
+def momentum_leaf(m, g, is_first, mu, dampening):
+    """torch-SGD momentum buffer update for one leaf: the very first step
+    initializes buf to the raw gradient (torch's buf-is-None branch), later
+    steps apply ``mu*buf + (1-dampening)*grad``. ``is_first`` must be
+    broadcastable against the leaf."""
+    return jnp.where(is_first, g, mu * m + (1.0 - dampening) * g)
+
+
+def direction_leaf(g, m_new, mu, nesterov):
+    """Effective update direction for one leaf after the momentum update:
+    ``g + mu*buf`` under nesterov, else the buffer itself."""
+    return g + mu * m_new if nesterov else m_new
+
+
+def vote_apply_leaf(p, voted, lr, wd):
+    """Apply the voted sign locally: weight decay + ``p - lr*sign``
+    (sign_sgd_worker.py:47-58)."""
+    return p - lr * (voted + wd * p)

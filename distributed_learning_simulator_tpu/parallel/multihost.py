@@ -25,35 +25,44 @@ def initialize_multihost(
     With no arguments, relies on the TPU environment's auto-configuration
     (the standard path on Cloud TPU pods); in a plain single-process
     environment that raises (nothing to auto-detect) and degrades to a
-    logged no-op, so one binary serves pods and laptops. With EXPLICIT
-    coordinator flags, failures are fatal: a misconfigured 2-process launch
-    must not silently split into two independent single-process runs that
-    each write a full set of artifacts.
+    logged no-op, so one binary serves pods and laptops. Safe to call when
+    jax.distributed is already initialized (logged no-op, any flags). With
+    EXPLICIT coordinator flags and no prior initialization, failures are
+    fatal: a misconfigured 2-process launch must not silently split into
+    two independent single-process runs that each write a full set of
+    artifacts.
     """
     logger = get_logger()
     explicit = any(
         v is not None
         for v in (coordinator_address, num_processes, process_id)
     )
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except (RuntimeError, ValueError) as e:
-        # RuntimeError: already initialized; ValueError: no coordinator
-        # configured and none auto-detectable (single-process environment).
-        if explicit:
-            raise RuntimeError(
-                "jax.distributed.initialize failed with explicit multihost "
-                f"flags (coordinator_address={coordinator_address!r}, "
-                f"num_processes={num_processes}, process_id={process_id}); "
-                "refusing to degrade to a single-process run"
-            ) from e
-        logger.info(
-            "jax.distributed.initialize skipped (single process): %s", e
-        )
+    if jax.distributed.is_initialized():
+        # Safe to re-call in an already-distributed process (a second
+        # run_simulation in the same driver, a retry) regardless of flags.
+        logger.info("jax.distributed already initialized; reusing it")
+    else:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except (RuntimeError, ValueError) as e:
+            # No coordinator configured and none auto-detectable (plain
+            # single-process environment).
+            if explicit:
+                raise RuntimeError(
+                    "jax.distributed.initialize failed with explicit "
+                    "multihost flags (coordinator_address="
+                    f"{coordinator_address!r}, "
+                    f"num_processes={num_processes}, "
+                    f"process_id={process_id}); refusing to degrade to a "
+                    "single-process run"
+                ) from e
+            logger.info(
+                "jax.distributed.initialize skipped (single process): %s", e
+            )
     n = len(jax.devices())
     logger.info(
         "multihost: process %d/%d, %d global devices",

@@ -1,0 +1,94 @@
+"""Relative mesh-scaling measurement on virtual CPU devices.
+
+Runs the same FedAvg workload with the client axis sharded over 1/2/4/8
+virtual host-CPU devices and reports steady-state round time + relative
+efficiency. This validates that the sharded program's collectives and
+layouts don't introduce scaling overhead — it does NOT measure real chip
+speedup (all virtual devices share the same host cores, so ideal scaling
+here is flat round time per device count only when host cores are not
+saturated; the honest signal is the absence of super-linear SLOWDOWN from
+resharding/collective overhead as the mesh grows).
+
+Usage:  python scripts/measure_scaling.py [clients] [rounds]
+Writes a markdown table to stdout (pasted into docs/PERFORMANCE.md).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+_WORKER = textwrap.dedent("""
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    mesh, clients, rounds, chunk = (int(a) for a in sys.argv[1:5])
+    config = ExperimentConfig(
+        dataset_name="synthetic",
+        model_name="mlp",
+        distributed_algorithm="fed",
+        worker_number=clients,
+        round=rounds + 1,
+        epoch=2,
+        learning_rate=0.1,
+        batch_size=16,
+        n_train=clients * 32,
+        n_test=256,
+        log_level="ERROR",
+        dataset_args={"difficulty": 0.5},
+        mesh_devices=mesh if mesh > 1 else None,
+        client_chunk_size=chunk if chunk > 0 else None,
+        compilation_cache_dir=None,
+    )
+    res = run_simulation(config, setup_logging=False)
+    steady = [h["round_seconds"] for h in res["history"][1:]]
+    print(json.dumps({
+        "mesh": mesh,
+        "round_s": sum(steady) / len(steady),
+        "acc": res["final_accuracy"],
+    }))
+""")
+
+
+def measure(mesh: int, clients: int, rounds: int, chunk: int) -> dict:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, "-c", _WORKER, str(mesh), str(clients),
+         str(rounds), str(chunk)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1800,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stderr[-2000:])
+    import json
+
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    clients = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    rows = [measure(m, clients, rounds, chunk) for m in (1, 2, 4, 8)]
+    base = rows[0]["round_s"]
+    print(f"\n{clients} clients x {rounds} rounds, mlp, synthetic data, "
+          f"chunk={chunk or 'none'} (virtual CPU devices)\n")
+    print("| mesh devices | round (s) | vs 1-device | accuracy |")
+    print("|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['mesh']} | {r['round_s']:.3f} "
+              f"| {base / r['round_s']:.2f}x | {r['acc']:.3f} |")
+
+
+if __name__ == "__main__":
+    main()

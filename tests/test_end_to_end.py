@@ -150,6 +150,42 @@ def test_fed_quant_client_eval_disabled(tiny_config):
         assert h["uplink_compression_ratio"] > 3.5
 
 
+def test_bf16_local_compute_learns_close_to_f32(tiny_config):
+    """local_compute_dtype='bfloat16' (per-client diverged state in bf16,
+    f32 aggregation) must track the f32 trajectory closely on a short run."""
+    f32 = _run(tiny_config, round=4)
+    bf16 = _run(tiny_config, round=4, local_compute_dtype="bfloat16")
+    a32 = [h["test_accuracy"] for h in f32["history"]]
+    a16 = [h["test_accuracy"] for h in bf16["history"]]
+    assert a16[-1] > 0.3  # learns
+    assert abs(a16[-1] - a32[-1]) < 0.1, (a16, a32)
+    # global params stay f32 (aggregation accumulates in f32)
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(bf16["global_params"])
+    assert all(leaf.dtype == np.float32 for leaf in leaves)
+
+
+def test_bf16_local_compute_shapley_materialize_path(tiny_config):
+    """The materializing path (Shapley keeps the client stack) restores f32
+    before subset statistics."""
+    res = _run(tiny_config, distributed_algorithm="multiround_shapley_value",
+               round=2, local_compute_dtype="bfloat16")
+    assert set(res["algorithm"].shapley_values) == {0, 1}
+
+
+def test_bf16_requires_reset_optimizer(tiny_config):
+    with pytest.raises(ValueError, match="reset_client_optimizer"):
+        _run(tiny_config, local_compute_dtype="bfloat16",
+             reset_client_optimizer=False)
+
+
+def test_bf16_rejected_for_sign_sgd(tiny_config):
+    with pytest.raises(ValueError, match="local_compute_dtype"):
+        _run(tiny_config, distributed_algorithm="sign_SGD",
+             local_compute_dtype="bfloat16")
+
+
 def test_multiround_shapley(tiny_config):
     res = _run(tiny_config, distributed_algorithm="multiround_shapley_value",
                round=2)

@@ -62,6 +62,47 @@ def test_sharded_matches_unsharded_sign_sgd(tiny_config):
     np.testing.assert_allclose(sharded, base, atol=1e-4)
 
 
+def test_chunked_sharded_composition_matches_baseline(tiny_config):
+    """client_chunk_size < cohort composed WITH mesh sharding — the flagship
+    large-model configuration (ResNet-18 at scale needs both at once) —
+    must equal the unchunked, unsharded run."""
+    base = _accs(tiny_config, worker_number=16, round=3)
+    both = _accs(tiny_config, worker_number=16, round=3, mesh_devices=8,
+                 client_chunk_size=4)
+    np.testing.assert_allclose(both, base, atol=1e-4)
+
+
+def test_chunked_sharded_remainder_matches_baseline(tiny_config):
+    """Chunk size that does not divide the cohort (remainder path) composed
+    with mesh sharding."""
+    base = _accs(tiny_config, worker_number=16, round=2)
+    both = _accs(tiny_config, worker_number=16, round=2, mesh_devices=8,
+                 client_chunk_size=5)
+    np.testing.assert_allclose(both, base, atol=1e-4)
+
+
+def test_chunked_sharded_materializing_path(tiny_config):
+    """The materializing path (robust aggregation keeps the full client
+    stack) under chunking + sharding together."""
+    base = _accs(tiny_config, worker_number=16, round=2,
+                 aggregation="median")
+    both = _accs(tiny_config, worker_number=16, round=2,
+                 aggregation="median", mesh_devices=8, client_chunk_size=4)
+    np.testing.assert_allclose(both, base, atol=1e-4)
+
+
+def test_chunked_sharded_participation_sampling(tiny_config):
+    """Client sampling (cohort < population) + chunking + sharding: the
+    three execution knobs compose."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=16, round=2, participation_fraction=0.5,
+        client_chunk_size=4, mesh_devices=8,
+    )
+    res = run_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 2
+    assert all(np.isfinite(h["test_accuracy"]) for h in res["history"])
+
+
 def test_uneven_clients_rejected(tiny_config):
     import pytest
 

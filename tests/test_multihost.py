@@ -80,6 +80,12 @@ _WORKER_CODE = textwrap.dedent("""
     )
     assert jax.process_count() == 2, jax.process_count()
     assert n == 2, n  # one cpu device per process, both visible globally
+    # Re-calling with explicit flags in an already-initialized process is
+    # a logged no-op, not a fatal error (a second run in one driver).
+    assert initialize_multihost(
+        coordinator_address=sys.argv[1], num_processes=2,
+        process_id=int(sys.argv[2]),
+    ) == 2
     # The mesh code needs no multihost-specific branch: a mesh over the
     # global device list spans both processes.
     from distributed_learning_simulator_tpu.parallel.mesh import make_mesh

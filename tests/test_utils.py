@@ -70,3 +70,64 @@ def test_payload_accounting():
     s = sign_payload_bytes(tree)
     assert s < q
     assert compression_ratio(raw, q) > 1.0
+
+
+def test_stochastic_round_bf16_unbiased():
+    """_sr_to_bf16's hash dither must be unbiased: averaged over many
+    salts, E[rounded] recovers values BETWEEN bf16 grid points (the
+    property bf16 local training's accuracy rests on), and grid points
+    round exactly."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.parallel.engine import _sr_to_bf16
+
+    # values straddling bf16 grid points at several magnitudes
+    base = np.array([1.0, 0.1, 0.01, -1.0, -0.25, 3.7], np.float32)
+    ulp = np.float32(2.0) ** (np.floor(np.log2(np.abs(base))) - 7)
+    x = jnp.asarray(base + 0.37 * ulp)  # 37% of the way to the next point
+
+    acc = np.zeros_like(base, np.float64)
+    n_salts = 4096
+    salt = jnp.uint32(12345)
+    for _ in range(n_salts):
+        r, salt = _sr_to_bf16(x, salt)
+        acc += np.asarray(r, np.float64)
+    mean = acc / n_salts
+    # mean must sit within a few percent of one ulp from the true value
+    err_ulps = np.abs(mean - np.asarray(x, np.float64)) / ulp
+    assert np.all(err_ulps < 0.05), err_ulps
+
+    # exact bf16 grid values are returned exactly (dither only touches the
+    # truncated low bits, which are zero on the grid)
+    grid = np.asarray(
+        jnp.asarray(base).astype(jnp.bfloat16).astype(jnp.float32)
+    )
+    r, _ = _sr_to_bf16(jnp.asarray(grid), jnp.uint32(7))
+    np.testing.assert_array_equal(np.asarray(r, np.float32), grid)
+
+
+def test_stochastic_round_decorrelated_across_salts():
+    """Different salts (= different clients) must make independent rounding
+    decisions for the same input value — the aggregate's unbiasedness
+    rests on this (see engine._sr_to_bf16)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.parallel.engine import _sr_to_bf16
+
+    ulp = np.float32(2.0 ** -7)
+    # mid-gap values with per-element sub-ulp jitter: real weights never
+    # collide bit-exactly, and the hash dithers per VALUE — identical
+    # bit patterns round identically within one salt (unlike a counter
+    # PRNG), which is fine for continuous-valued weights
+    jitter = (np.arange(256, dtype=np.float32) - 128) * np.float32(2e-5)
+    x = jnp.asarray(1.0 + (0.5 + jitter) * ulp, jnp.float32)
+    r1, _ = _sr_to_bf16(x, jnp.uint32(1))
+    r2, _ = _sr_to_bf16(x, jnp.uint32(2))
+    up1 = np.asarray(r1, np.float32) > 1.0
+    up2 = np.asarray(r2, np.float32) > 1.0
+    # each salt mixes up/down across elements, and salts disagree often
+    assert 0.2 < up1.mean() < 0.8
+    assert 0.2 < up2.mean() < 0.8
+    assert (up1 != up2).mean() > 0.2
