@@ -60,13 +60,22 @@ from distributed_learning_simulator_tpu.parallel.engine import (
 from distributed_learning_simulator_tpu.runtime.native import (
     NativeTaskQueue,
     NativeThreadPool,
-    RepeatedResult,
 )
 from distributed_learning_simulator_tpu.utils.logging import get_logger
 
 
 class ThreadedServer:
-    """Queue-owning server (reference servers/server.py + fed_server.py)."""
+    """Queue-owning server (reference servers/server.py + fed_server.py).
+
+    Downlink routing deviates from the reference deliberately: the
+    reference broadcasts N copies into ONE shared result pool
+    (RepeatedResult, fed_server.py:88-91), which has a copy-stealing race —
+    a fast worker that finishes its next local run before a descheduled
+    peer pops its copy can consume the peer's stale copy as if it were the
+    next round's broadcast, desynchronizing the two and deadlocking the
+    barrier. Results are routed per worker here (one downlink queue each,
+    same blocking-rendezvous contract); the shared uplink queue and its
+    worker_fun callback remain exactly the reference's shape."""
 
     def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
                  init_params_tree, metrics_path: str | None = None):
@@ -80,13 +89,18 @@ class ThreadedServer:
         self.metrics_path = metrics_path
         self.prev_model = init_params_tree
         self._round_t0 = time.perf_counter()
+        self.result_queues = [
+            NativeTaskQueue() for _ in range(self.worker_number)
+        ]
         self.worker_data_queue = NativeTaskQueue(
             worker_fun=self._process_worker_data
         )
         # Seed the initial broadcast (fed_server.py:16-24).
-        self.worker_data_queue.put_result(
-            jax.device_get(init_params_tree), copies=self.worker_number
-        )
+        self._broadcast(jax.device_get(init_params_tree))
+
+    def _broadcast(self, payload) -> None:
+        for q in self.result_queues:
+            q.put_result(payload)
 
     # Template hooks (fed_server.py:38-42).
     def _process_client_parameter(self, worker_id: int, params):
@@ -150,19 +164,24 @@ class ThreadedServer:
         self._round += 1
         self._round_t0 = time.perf_counter()
         self._buffer.clear()
-        return RepeatedResult(jax.device_get(aggregated), self.worker_number)
+        self._broadcast(jax.device_get(aggregated))
+        return None
 
     def stop(self):
         self.worker_data_queue.stop()
+        for q in self.result_queues:
+            q.stop()
 
 
 class ThreadedWorker:
     """One simulated client on its own thread (reference workers/fed_worker.py)."""
 
-    def __init__(self, worker_id: int, queue: NativeTaskQueue, local_train,
-                 shard, rounds: int, seed: int):
+    def __init__(self, worker_id: int, queue: NativeTaskQueue,
+                 result_queue: NativeTaskQueue, local_train, shard,
+                 rounds: int, seed: int):
         self.worker_id = worker_id
         self.queue = queue
+        self.result_queue = result_queue
         self._local_train = local_train
         self._shard = shard  # (xs, ys, mask, size)
         self._rounds = rounds
@@ -173,7 +192,7 @@ class ThreadedWorker:
         key = jax.random.key(self._seed * 100003 + self.worker_id)
         for _ in range(self._rounds):
             # Block for the current global model (fed_worker.py:22,37).
-            params = self.queue.get_result()
+            params = self.result_queue.get_result()
             params = jax.tree_util.tree_map(jnp.asarray, params)
             key, round_key = jax.random.split(key)
             new_params, _, _ = self._local_train(
@@ -192,11 +211,17 @@ class ThreadedSignSGDServer:
 
     Buffers each worker's per-step sign gradients; on the Nth arrival sums
     elementwise and re-signs (sign_sgd_server.py:16-18), broadcasts the vote
-    N times, and applies the vote to its own params replica — valid because
-    every worker applies the identical update, so server and workers stay in
-    bitwise lockstep (same jitted apply). At round boundaries (every
-    ``steps_per_round`` votes) it evaluates the replica and records the
-    per-round history the differential-testing oracle compares."""
+    to every worker, and applies the vote to its own params replica — valid
+    because every worker applies the identical update, so server and
+    workers stay in bitwise lockstep (same jitted apply). At round
+    boundaries (every ``steps_per_round`` votes) it evaluates the replica
+    and records the per-round history the differential-testing oracle
+    compares.
+
+    Votes are routed per worker (one downlink queue each) rather than N
+    copies in one shared pool: per-step sync re-runs the rendezvous
+    thousands of times per run, so the shared-pool copy-stealing race (see
+    ThreadedServer) would be an eventual deadlock, not a curiosity."""
 
     def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
                  init_params_tree, apply_vote, steps_per_round: int,
@@ -213,6 +238,9 @@ class ThreadedSignSGDServer:
         self.metrics_path = metrics_path
         self.params = init_params_tree
         self._round_t0 = time.perf_counter()
+        self.result_queues = [
+            NativeTaskQueue() for _ in range(self.worker_number)
+        ]
         self.worker_data_queue = NativeTaskQueue(
             worker_fun=self._process_worker_data
         )
@@ -272,10 +300,14 @@ class ThreadedSignSGDServer:
                 round_idx, metrics["accuracy"], metrics["loss"],
             )
             self._round_t0 = time.perf_counter()
-        return RepeatedResult(voted, self.worker_number)
+        for q in self.result_queues:
+            q.put_result(voted)
+        return None
 
     def stop(self):
         self.worker_data_queue.stop()
+        for q in self.result_queues:
+            q.stop()
 
 
 class ThreadedSignSGDWorker:
@@ -284,11 +316,13 @@ class ThreadedSignSGDWorker:
     SGD direction (torch momentum math incl. buf=grad first step, :22-42),
     sign it, submit, block for the vote, apply locally (:44-58)."""
 
-    def __init__(self, worker_id: int, queue: NativeTaskQueue, direction_fn,
+    def __init__(self, worker_id: int, queue: NativeTaskQueue,
+                 result_queue: NativeTaskQueue, direction_fn,
                  apply_vote, shard, init_params_tree, rounds: int,
                  epochs: int, batch_size: int, seed: int):
         self.worker_id = worker_id
         self.queue = queue
+        self.result_queue = result_queue
         self._direction = direction_fn
         self._apply_vote = apply_vote
         self._shard = shard  # (xs, ys, mask, size)
@@ -319,7 +353,7 @@ class ThreadedSignSGDWorker:
                     self.queue.add_task(
                         (self.worker_id, jax.device_get(signs))
                     )
-                    voted = self.queue.get_result()
+                    voted = self.result_queue.get_result()
                     params = self._apply_vote(
                         params, jax.tree_util.tree_map(jnp.asarray, voted)
                     )
@@ -374,6 +408,14 @@ def run_threaded_simulation(
         raise ValueError(
             "threaded execution mode does not support checkpoint/resume; "
             "use the vmap execution mode"
+        )
+    if config.local_compute_dtype != "float32":
+        # The bf16 + stochastic-rounding local state lives in the vmap
+        # engine; running threaded in f32 while the config asks for bf16
+        # would silently break the oracle's same-semantics claim.
+        raise ValueError(
+            "threaded execution mode does not support local_compute_dtype="
+            f"{config.local_compute_dtype!r}; use the vmap execution mode"
         )
     from distributed_learning_simulator_tpu.utils.logging import (
         set_level,
@@ -446,7 +488,8 @@ def run_threaded_simulation(
 
         def make_worker(worker_id, shard):
             return ThreadedWorker(
-                worker_id, server.worker_data_queue, local_train, shard,
+                worker_id, server.worker_data_queue,
+                server.result_queues[worker_id], local_train, shard,
                 config.round, config.seed,
             )
 
@@ -533,7 +576,8 @@ def _build_sign_sgd(config, model, params, evaluate, eval_batches, decoder,
 
     def make_worker(worker_id, shard):
         return ThreadedSignSGDWorker(
-            worker_id, server.worker_data_queue, direction_fn, apply_vote,
+            worker_id, server.worker_data_queue,
+            server.result_queues[worker_id], direction_fn, apply_vote,
             shard, params, config.round, config.epoch, config.batch_size,
             config.seed,
         )

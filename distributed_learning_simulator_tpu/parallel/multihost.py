@@ -39,7 +39,25 @@ def initialize_multihost(
     )
     if jax.distributed.is_initialized():
         # Safe to re-call in an already-distributed process (a second
-        # run_simulation in the same driver, a retry) regardless of flags.
+        # run_simulation in the same driver, a retry) — but explicit flags
+        # must MATCH the live topology: reusing a single-process runtime
+        # when the caller asked for process 1-of-2 is exactly the silent
+        # split this function's contract forbids.
+        if explicit:
+            if (
+                num_processes is not None
+                and jax.process_count() != num_processes
+            ) or (
+                process_id is not None
+                and jax.process_index() != process_id
+            ):
+                raise RuntimeError(
+                    "jax.distributed is already initialized as process "
+                    f"{jax.process_index()}/{jax.process_count()}, which "
+                    "does not match the explicit multihost flags "
+                    f"(num_processes={num_processes}, "
+                    f"process_id={process_id}); refusing to proceed"
+                )
         logger.info("jax.distributed already initialized; reusing it")
     else:
         try:

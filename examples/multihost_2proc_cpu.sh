@@ -1,0 +1,34 @@
+#!/bin/bash
+# Multi-host (DCN) bring-up demo on plain CPU: two processes join one
+# jax.distributed job over localhost, after which jax.devices() spans both
+# processes and the ordinary mesh/sharding code runs the client axis
+# across them (on a TPU pod, just pass --multihost true and let the
+# environment auto-configure; the explicit flags below are for non-TPU
+# clusters and CI). Each process must see the same worker_number and a
+# mesh over the GLOBAL device count.
+#
+# The python -c wrapper pins the CPU platform via jax.config BEFORE any
+# backend initialization: JAX_PLATFORMS alone loses to force-registered
+# accelerator plugins (and an accelerator plugin may bring its own
+# pre-initialized distributed runtime, which would make this demo a no-op).
+set -e
+PORT=${PORT:-8476}
+
+run() {
+  python -c "
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from distributed_learning_simulator_tpu.simulator import main
+main()
+" \
+    --dataset_name synthetic --model_name mlp --distributed_algorithm fed \
+    --worker_number 8 --round 3 --epoch 1 --learning_rate 0.1 \
+    --multihost true --coordinator_address "127.0.0.1:$PORT" \
+    --num_processes 2 --process_id "$1" \
+    --mesh_devices 2 --log_level INFO
+}
+
+run 0 &
+PID0=$!
+run 1
+wait $PID0

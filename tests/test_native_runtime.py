@@ -181,6 +181,18 @@ def test_threaded_rejects_other_algorithms(tiny_config):
         run_threaded_simulation(cfg)
 
 
+def test_threaded_rejects_bf16_local_state(tiny_config):
+    """The bf16/SR local state lives in the vmap engine; threaded mode must
+    reject it rather than silently run f32 (oracle same-semantics claim)."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, local_compute_dtype="bfloat16")
+    with pytest.raises(ValueError, match="local_compute_dtype"):
+        run_threaded_simulation(cfg)
+
+
 def test_threaded_sign_sgd_learns(tiny_config):
     """Per-step sign-vote sync over the native queue (the reference's
     finest-grained communication pattern, sign_sgd_worker.py:44-47)."""
