@@ -64,18 +64,56 @@ from distributed_learning_simulator_tpu.runtime.native import (
 from distributed_learning_simulator_tpu.utils.logging import get_logger
 
 
-class ThreadedServer:
-    """Queue-owning server (reference servers/server.py + fed_server.py).
+class _QueueServerBase:
+    """Shared rendezvous plumbing for the threaded servers.
 
     Downlink routing deviates from the reference deliberately: the
     reference broadcasts N copies into ONE shared result pool
     (RepeatedResult, fed_server.py:88-91), which has a copy-stealing race —
     a fast worker that finishes its next local run before a descheduled
     peer pops its copy can consume the peer's stale copy as if it were the
-    next round's broadcast, desynchronizing the two and deadlocking the
-    barrier. Results are routed per worker here (one downlink queue each,
-    same blocking-rendezvous contract); the shared uplink queue and its
-    worker_fun callback remain exactly the reference's shape."""
+    next rendezvous' broadcast, desynchronizing the two and deadlocking
+    the barrier. Results are routed per worker here (one downlink queue
+    each, same blocking-rendezvous contract); the shared uplink queue and
+    its worker_fun callback remain exactly the reference's shape."""
+
+    worker_number: int
+
+    def _init_queues(self) -> None:
+        self.result_queues = [
+            NativeTaskQueue() for _ in range(self.worker_number)
+        ]
+        self.worker_data_queue = NativeTaskQueue(
+            worker_fun=self._process_worker_data
+        )
+
+    def _process_worker_data(self, data, extra_args):  # pragma: no cover
+        raise NotImplementedError
+
+    def _broadcast(self, payload) -> None:
+        import pickle
+
+        # Serialize once, enqueue the same bytes N times (a per-queue
+        # put_result would re-pickle the full model per worker — per STEP
+        # for sign_SGD).
+        blob = pickle.dumps(payload)
+        try:
+            for q in self.result_queues:
+                q.put_result_pickled(blob)
+        except RuntimeError:
+            # stop() raced the final broadcast; nobody is listening. The
+            # old RepeatedResult path got this guard from the queue's
+            # _serve loop — replicate it here.
+            pass
+
+    def stop(self):
+        self.worker_data_queue.stop()
+        for q in self.result_queues:
+            q.stop()
+
+
+class ThreadedServer(_QueueServerBase):
+    """Queue-owning server (reference servers/server.py + fed_server.py)."""
 
     def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
                  init_params_tree, metrics_path: str | None = None):
@@ -89,18 +127,9 @@ class ThreadedServer:
         self.metrics_path = metrics_path
         self.prev_model = init_params_tree
         self._round_t0 = time.perf_counter()
-        self.result_queues = [
-            NativeTaskQueue() for _ in range(self.worker_number)
-        ]
-        self.worker_data_queue = NativeTaskQueue(
-            worker_fun=self._process_worker_data
-        )
+        self._init_queues()
         # Seed the initial broadcast (fed_server.py:16-24).
         self._broadcast(jax.device_get(init_params_tree))
-
-    def _broadcast(self, payload) -> None:
-        for q in self.result_queues:
-            q.put_result(payload)
 
     # Template hooks (fed_server.py:38-42).
     def _process_client_parameter(self, worker_id: int, params):
@@ -167,11 +196,6 @@ class ThreadedServer:
         self._broadcast(jax.device_get(aggregated))
         return None
 
-    def stop(self):
-        self.worker_data_queue.stop()
-        for q in self.result_queues:
-            q.stop()
-
 
 class ThreadedWorker:
     """One simulated client on its own thread (reference workers/fed_worker.py)."""
@@ -204,7 +228,7 @@ class ThreadedWorker:
             )
 
 
-class ThreadedSignSGDServer:
+class ThreadedSignSGDServer(_QueueServerBase):
     """Per-step majority-vote server (reference servers/sign_sgd_server.py,
     with the vote actually wired to the queue callback — the reference's
     name-mangled ``__worker`` is dead code, SURVEY 2.1#13).
@@ -221,7 +245,7 @@ class ThreadedSignSGDServer:
     Votes are routed per worker (one downlink queue each) rather than N
     copies in one shared pool: per-step sync re-runs the rendezvous
     thousands of times per run, so the shared-pool copy-stealing race (see
-    ThreadedServer) would be an eventual deadlock, not a curiosity."""
+    _QueueServerBase) would be an eventual deadlock, not a curiosity."""
 
     def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
                  init_params_tree, apply_vote, steps_per_round: int,
@@ -238,12 +262,7 @@ class ThreadedSignSGDServer:
         self.metrics_path = metrics_path
         self.params = init_params_tree
         self._round_t0 = time.perf_counter()
-        self.result_queues = [
-            NativeTaskQueue() for _ in range(self.worker_number)
-        ]
-        self.worker_data_queue = NativeTaskQueue(
-            worker_fun=self._process_worker_data
-        )
+        self._init_queues()
         # No initial broadcast: the reference SignSGDServer extends the bare
         # Server (no FedServer param seeding); workers start from the same
         # deterministic init instead.
@@ -300,14 +319,8 @@ class ThreadedSignSGDServer:
                 round_idx, metrics["accuracy"], metrics["loss"],
             )
             self._round_t0 = time.perf_counter()
-        for q in self.result_queues:
-            q.put_result(voted)
+        self._broadcast(voted)
         return None
-
-    def stop(self):
-        self.worker_data_queue.stop()
-        for q in self.result_queues:
-            q.stop()
 
 
 class ThreadedSignSGDWorker:
@@ -503,11 +516,26 @@ def run_threaded_simulation(
                 float(client_data.sizes[worker_id]),
             )
             pool.exec(make_worker(worker_id, shard).train)
+        # Error-aware wait instead of a blocking join: if one worker dies,
+        # the barrier can never fill and its peers block forever in
+        # get_result — a plain join_pending would deadlock. On the first
+        # error, stop the server queues (unblocking the waiters with
+        # "queue is stopped"), THEN join; pool.results() re-raises the
+        # original error (errors are recorded in arrival order).
+        while True:
+            done, submitted, failed = pool.poll()
+            if failed or done == submitted:
+                break
+            time.sleep(0.02)
+        if failed:
+            server.stop()
         pool.join_pending()
         pool.results()  # re-raise any worker error
     finally:
-        pool.stop()
+        # Server first: pool.stop() joins pending work, and any worker
+        # still blocked in get_result only unblocks once the queues stop.
         server.stop()
+        pool.stop()
     total = time.perf_counter() - t_start
     history = server.history
     n = client_data.n_clients

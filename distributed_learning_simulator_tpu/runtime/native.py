@@ -180,7 +180,11 @@ class NativeTaskQueue:
         return self._take(self._lib.dlsq_get_task)
 
     def put_result(self, obj: Any, copies: int = 1) -> None:
-        payload = pickle.dumps(obj)
+        self.put_result_pickled(pickle.dumps(obj), copies=copies)
+
+    def put_result_pickled(self, payload: bytes, copies: int = 1) -> None:
+        """Enqueue an already-pickled payload — lets a broadcast to N
+        per-worker queues serialize the object once instead of N times."""
         rc = self._lib.dlsq_put_result(self._q, payload, len(payload), copies)
         if rc != 0:
             raise RuntimeError("queue is stopped")
@@ -248,6 +252,17 @@ class NativeThreadPool:
     def join_pending(self) -> None:
         """Block until every submitted task has run."""
         self._lib.dlsp_join_pending(self._pool)
+
+    def poll(self) -> tuple[int, int, bool]:
+        """Non-blocking progress probe: (completed, submitted, any_error).
+
+        Lets a coordinator wait for workers WITHOUT committing to a blocking
+        join — on the first error it can tear down the rendezvous queues so
+        peers blocked in get_result unblock instead of deadlocking on a
+        barrier that can never fill."""
+        with self._lock:
+            done = len(self._results) + len(self._errors)
+            return done, self._next_id, bool(self._errors)
 
     def results(self) -> dict[int, Any]:
         """Completed results by task id; raises the first captured error."""

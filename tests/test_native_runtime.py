@@ -210,6 +210,63 @@ def test_threaded_sign_sgd_learns(tiny_config):
     assert res["history"][-1]["sync_steps"] >= 1
 
 
+def test_threaded_worker_failure_raises_not_hangs(tiny_config, monkeypatch):
+    """If one worker dies, the run must re-raise its error promptly instead
+    of deadlocking on a barrier that can never fill (the error-aware wait
+    stops the rendezvous queues to unblock the surviving workers)."""
+    import distributed_learning_simulator_tpu.execution.threaded as thr
+
+    original = thr.ThreadedWorker.train
+
+    def sabotaged(self):
+        if self.worker_id == 2:
+            raise RuntimeError("client exploded mid-round")
+        return original(self)
+
+    monkeypatch.setattr(thr.ThreadedWorker, "train", sabotaged)
+    cfg = dataclasses.replace(tiny_config, round=3)
+    import time as _time
+
+    t0 = _time.perf_counter()
+    with pytest.raises(RuntimeError, match="client exploded"):
+        thr.run_threaded_simulation(cfg, setup_logging=False)
+    assert _time.perf_counter() - t0 < 60  # promptly, not a hang
+
+
+def test_threaded_fed_matches_vmap(tiny_config):
+    """Differential oracle for FedAvg: thread-per-client over the native
+    queue vs the fused vmap round program must agree statistically
+    (batch orders differ, so not bitwise)."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(tiny_config, round=4)
+    threaded = run_threaded_simulation(cfg, setup_logging=False)
+    vmapped = run_simulation(cfg, setup_logging=False)
+    a_t = threaded["history"][-1]["test_accuracy"]
+    a_v = vmapped["history"][-1]["test_accuracy"]
+    assert abs(a_t - a_v) < 0.15, (a_t, a_v)
+
+
+def test_threaded_sign_sgd_many_steps_no_deadlock(tiny_config):
+    """Scheduling-stress regression for the per-worker downlink routing:
+    many per-step rendezvous across 8 workers must complete (the shared
+    N-copy result pool this replaced could deadlock via copy stealing)."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="sign_SGD", worker_number=8,
+        learning_rate=0.01, round=3, epoch=2, batch_size=8,
+    )
+    res = run_threaded_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 3
+    assert res["history"][-1]["sync_steps"] >= 8  # many rendezvous ran
+
+
 def test_threaded_sign_sgd_matches_vmap(tiny_config):
     """Differential oracle: thread-per-client per-step voting vs the fused
     in-program vote must agree statistically (batch orders differ)."""
