@@ -31,9 +31,7 @@ import jax.numpy as jnp
 from distributed_learning_simulator_tpu.algorithms.base import Algorithm
 from distributed_learning_simulator_tpu.ops.sign import (
     direction_leaf,
-    majority_vote,
     momentum_leaf,
-    sign_compress,
     vote_apply_leaf,
 )
 from distributed_learning_simulator_tpu.parallel.engine import make_loss_fn
@@ -70,12 +68,25 @@ class SignSGD(Algorithm):
                 "sign_SGD does not use local_compute_dtype; set it to "
                 "'float32'"
             )
+        if getattr(config, "participation_fraction", 1.0) < 1.0:
+            # Per-step votes are over the FULL population (the reference
+            # barrier, sign_sgd_server.py:13-18); reject rather than
+            # silently train everyone.
+            raise ValueError(
+                "sign_SGD votes over every client each step; "
+                "participation_fraction < 1 is not supported"
+            )
 
     def init_client_state(self, optimizer, global_params, n_clients):
         """Per-client momentum buffers + step counters (reference replicates
         torch-SGD momentum state per worker, sign_sgd_worker.py:22-42; the
         counter reproduces torch's buf-initialized-to-raw-gradient first
-        step)."""
+        step). With momentum 0 there is NO buffer (torch never allocates
+        one) — at 1000 clients x ResNet-18 the buffers alone would be
+        ~44 GB, so skipping them is what makes momentum-free sign_SGD run
+        at large-model scale on one chip."""
+        if self.config.momentum == 0.0:
+            return None
         zeros = jax.tree_util.tree_map(jnp.zeros_like, global_params)
         momenta = jax.tree_util.tree_map(
             lambda z: jnp.broadcast_to(z, (n_clients,) + z.shape), zeros
@@ -95,10 +106,48 @@ class SignSGD(Algorithm):
         loss_fn = make_loss_fn(apply_fn)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+        chunk = cfg.client_chunk_size
+        has_momentum = mu != 0.0
+
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
             del sizes  # vote is unweighted, parity with sign_sgd_server.py:16-18
             shard_size = cx.shape[1]
             steps_per_epoch = shard_size // batch_size
+
+            def chunk_compute(params, momenta_c, is_first_c, bx, by, bm):
+                """Per-chunk: grads at the shared params -> torch-SGD
+                direction -> partial sign-sum over the chunk's clients.
+                Returns (vote partial sums, new momenta, summed loss)."""
+                if preprocess is not None:
+                    bx = jax.vmap(preprocess)(bx)
+                (losses, _), grads = jax.vmap(
+                    grad_fn, in_axes=(None, 0, 0, 0)
+                )(params, bx, by, bm)
+                if has_momentum:
+                    # torch-SGD step math: ops/sign.py leaf formulas, the
+                    # single source shared with the threaded oracle.
+                    momenta_new = jax.tree_util.tree_map(
+                        lambda m, g: momentum_leaf(
+                            m, g,
+                            is_first_c.reshape((-1,) + (1,) * (g.ndim - 1)),
+                            mu, dampening,
+                        ),
+                        momenta_c, grads,
+                    )
+                    direction = jax.tree_util.tree_map(
+                        lambda g, m: direction_leaf(g, m, mu, nesterov),
+                        grads, momenta_new,
+                    )
+                else:
+                    # torch allocates no buffer at momentum 0: the
+                    # direction IS the raw gradient (nesterov with mu=0
+                    # reduces to it too).
+                    momenta_new = momenta_c
+                    direction = grads
+                partial = jax.tree_util.tree_map(
+                    lambda d: jnp.sum(jnp.sign(d), axis=0), direction
+                )
+                return partial, momenta_new, jnp.sum(losses)
 
             def epoch_body(carry, epoch_key):
                 params, momenta, step_counts = carry
@@ -115,35 +164,84 @@ class SignSGD(Algorithm):
                     bx = jax.vmap(lambda x, i: jnp.take(x, i, axis=0))(cx, idx)
                     by = jax.vmap(lambda y, i: jnp.take(y, i, axis=0))(cy, idx)
                     bm = jax.vmap(lambda m, i: jnp.take(m, i, axis=0))(cmask, idx)
-                    if preprocess is not None:
-                        bx = jax.vmap(preprocess)(bx)
-                    # Per-client gradients at the SHARED params.
-                    (losses, _), grads = jax.vmap(
-                        grad_fn, in_axes=(None, 0, 0, 0)
-                    )(params, bx, by, bm)
-                    # torch-SGD step math: ops/sign.py leaf formulas, the
-                    # single source shared with the threaded oracle.
                     is_first = step_counts == 0  # [C]
 
-                    momenta_new = jax.tree_util.tree_map(
-                        lambda m, g: momentum_leaf(
-                            m, g,
-                            is_first.reshape((-1,) + (1,) * (g.ndim - 1)),
-                            mu, dampening,
-                        ),
-                        momenta, grads,
-                    )
-                    direction = jax.tree_util.tree_map(
-                        lambda g, m: direction_leaf(g, m, mu, nesterov),
-                        grads, momenta_new,
-                    )
-                    # sign -> sum over clients -> sign: the majority vote.
-                    voted = majority_vote(sign_compress(direction))
+                    if chunk is None or chunk >= n_clients:
+                        vote_sum, momenta_new, loss_sum = chunk_compute(
+                            params, momenta, is_first, bx, by, bm
+                        )
+                    else:
+                        # Chunked vote: per-client gradients exist only
+                        # chunk-at-a-time; partial sign-sums accumulate into
+                        # the vote so the full [n_clients, n_params] gradient
+                        # stack never materializes (at 1000 clients x
+                        # ResNet-18 it would be ~44 GB). Remainder clients
+                        # (n % chunk) get their own call, same as fedavg's
+                        # train_and_reduce — any chunk size works.
+                        n_chunks, rem = divmod(n_clients, chunk)
+                        trees = (momenta, is_first, bx, by, bm)
+                        head = jax.tree_util.tree_map(
+                            lambda a: a[: n_clients - rem], trees
+                        )
+                        resh = lambda a: a.reshape(
+                            (n_chunks, chunk) + a.shape[1:]
+                        )
+                        xs = jax.tree_util.tree_map(resh, head)
+
+                        def body(acc, chunk_args):
+                            m_c, f_c, bx_c, by_c, bm_c = chunk_args
+                            partial, m_new, l_sum = chunk_compute(
+                                params, m_c, f_c, bx_c, by_c, bm_c
+                            )
+                            acc_votes, acc_loss = acc
+                            acc_votes = jax.tree_util.tree_map(
+                                jnp.add, acc_votes, partial
+                            )
+                            return (acc_votes, acc_loss + l_sum), m_new
+
+                        acc0 = (
+                            jax.tree_util.tree_map(
+                                lambda p: jnp.zeros_like(p, jnp.float32),
+                                params,
+                            ),
+                            jnp.float32(0.0),
+                        )
+                        (vote_sum, loss_sum), m_stacked = jax.lax.scan(
+                            body, acc0, xs
+                        )
+                        momenta_new = jax.tree_util.tree_map(
+                            lambda a: a.reshape(
+                                (n_clients - rem,) + a.shape[2:]
+                            ),
+                            m_stacked,
+                        )
+                        if rem:
+                            m_t, f_t, bx_t, by_t, bm_t = (
+                                jax.tree_util.tree_map(
+                                    lambda a: a[n_clients - rem:], trees
+                                )
+                            )
+                            partial_t, m_new_t, l_t = chunk_compute(
+                                params, m_t, f_t, bx_t, by_t, bm_t
+                            )
+                            vote_sum = jax.tree_util.tree_map(
+                                jnp.add, vote_sum, partial_t
+                            )
+                            loss_sum = loss_sum + l_t
+                            momenta_new = jax.tree_util.tree_map(
+                                lambda a, b: jnp.concatenate([a, b], axis=0),
+                                momenta_new, m_new_t,
+                            )
+                    # sign of the summed signs: the majority vote
+                    # (sign_sgd_server.py:16-18).
+                    voted = jax.tree_util.tree_map(jnp.sign, vote_sum)
                     params = jax.tree_util.tree_map(
                         lambda p, v: vote_apply_leaf(p, v, lr, wd),
                         params, voted,
                     )
-                    return (params, momenta_new, step_counts + 1), jnp.mean(losses)
+                    return (params, momenta_new, step_counts + 1), (
+                        loss_sum / n_clients
+                    )
 
                 (params, momenta, step_counts), step_losses = jax.lax.scan(
                     step_body, (params, momenta, step_counts),
@@ -152,9 +250,13 @@ class SignSGD(Algorithm):
                 return (params, momenta, step_counts), jnp.mean(step_losses)
 
             epoch_keys = jax.random.split(key, epochs)
-            carry0 = (
-                global_params, client_state["momenta"], client_state["steps"]
-            )
+            if has_momentum:
+                momenta0 = client_state["momenta"]
+                steps0 = client_state["steps"]
+            else:
+                momenta0 = None
+                steps0 = jnp.zeros(n_clients, jnp.int32)
+            carry0 = (global_params, momenta0, steps0)
             (params, momenta, step_counts), epoch_losses = jax.lax.scan(
                 epoch_body, carry0, epoch_keys
             )
@@ -162,7 +264,10 @@ class SignSGD(Algorithm):
                 "mean_client_loss": epoch_losses[-1],
                 "sync_steps": jnp.asarray(epochs * steps_per_epoch),
             }
-            new_state = {"momenta": momenta, "steps": step_counts}
+            new_state = (
+                {"momenta": momenta, "steps": step_counts}
+                if has_momentum else None
+            )
             return params, new_state, aux
 
         return round_fn

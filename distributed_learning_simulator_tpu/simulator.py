@@ -286,6 +286,20 @@ def run_simulation(
             global_params = jax.tree_util.tree_map(
                 jnp.asarray, ckpt["global_params"]
             )
+            want_cs = jax.tree_util.tree_structure(client_state)
+            got_cs = jax.tree_util.tree_structure(ckpt["client_state"])
+            if want_cs != got_cs:
+                # e.g. a sign_SGD checkpoint written with momentum=0 has no
+                # per-client buffers (client_state=None) while momentum>0
+                # expects them — resuming across that mismatch would either
+                # crash inside jit or silently drop the saved buffers.
+                raise ValueError(
+                    "checkpoint client_state does not match this "
+                    "configuration (e.g. momentum / reset_client_optimizer "
+                    "changed since the checkpoint was written); resume with "
+                    "the configuration the checkpoint was written with "
+                    f"(checkpoint: {got_cs}, config: {want_cs})"
+                )
             client_state = jax.tree_util.tree_map(
                 jnp.asarray, ckpt["client_state"]
             )

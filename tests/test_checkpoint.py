@@ -3,6 +3,8 @@ which has no persistence at all — SURVEY §5)."""
 
 import dataclasses
 
+import pytest
+
 from distributed_learning_simulator_tpu.simulator import run_simulation
 from distributed_learning_simulator_tpu.utils.checkpoint import (
     latest_checkpoint,
@@ -99,3 +101,28 @@ def test_resume_matches_straight_run(tiny_config, tmp_path):
     straight_accs = [h["test_accuracy"] for h in straight["history"]]
     resumed_accs = [h["test_accuracy"] for h in resumed["history"]]
     assert resumed_accs == straight_accs[2:]
+
+
+def test_resume_client_state_mismatch_raises(tiny_config, tmp_path):
+    """A checkpoint whose per-client state shape disagrees with the current
+    config (e.g. sign_SGD momentum=0 -> no buffers, momentum>0 -> buffers)
+    must fail loudly instead of crashing inside jit or silently dropping
+    the saved buffers."""
+    ckdir = str(tmp_path / "ck")
+    run_simulation(
+        dataclasses.replace(
+            tiny_config, distributed_algorithm="sign_SGD",
+            learning_rate=0.01, momentum=0.0, round=2,
+            checkpoint_dir=ckdir, checkpoint_every=1,
+        ),
+        setup_logging=False,
+    )
+    with pytest.raises(ValueError, match="client_state"):
+        run_simulation(
+            dataclasses.replace(
+                tiny_config, distributed_algorithm="sign_SGD",
+                learning_rate=0.01, momentum=0.9, round=3,
+                checkpoint_dir=ckdir, resume=True,
+            ),
+            setup_logging=False,
+        )

@@ -98,6 +98,22 @@ def test_npz_path_end_to_end_real_pixels(tmp_path):
     assert res["history"][-1]["test_accuracy"] > 0.8
 
 
+def test_bf16_sr_matches_f32_on_real_pixels():
+    """bf16 local state with hash-dither SR must track the f32 trajectory
+    on REAL data over a moderate horizon (the synthetic tiny-config test
+    can't catch slow stochastic-rounding bias; 50-round bench comparisons
+    in docs/PERFORMANCE.md are the long-horizon evidence)."""
+    f32 = run_simulation(_digits_config(round=10), setup_logging=False)
+    bf16 = run_simulation(
+        _digits_config(round=10, local_compute_dtype="bfloat16"),
+        setup_logging=False,
+    )
+    a32 = f32["history"][-1]["test_accuracy"]
+    a16 = bf16["history"][-1]["test_accuracy"]
+    assert a16 > 0.85
+    assert abs(a16 - a32) < 0.05, (a16, a32)
+
+
 def test_fed_quant_real_digits_telemetry():
     """Quantized exchange + per-client eval telemetry on real pixels."""
     res = run_simulation(
