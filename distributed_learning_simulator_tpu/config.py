@@ -129,7 +129,10 @@ class ExperimentConfig:
     # Max clients trained concurrently inside one round program. None = all
     # at once (pure vmap). At large N the per-client params/grads/momentum
     # copies and activations exceed HBM; chunking runs vmap-ed chunks
-    # sequentially (lax.map) with identical semantics.
+    # sequentially (lax.map) with identical semantics. 0 = auto: computed
+    # at startup from the same per-client footprint model the OOM
+    # diagnostics use (~4x f32 param bytes per in-flight client, 60% of
+    # per-device HBM x mesh size), clamped to the cohort.
     client_chunk_size: int | None = None
     # Fraction of clients sampled (without replacement) to train+aggregate
     # each round (FedAvg-family). 1.0 = all clients, the reference's fixed
@@ -222,6 +225,10 @@ class ExperimentConfig:
                 "local_compute_dtype='bfloat16' requires "
                 "reset_client_optimizer=True (persistent per-client "
                 "optimizer state is f32 and would mix dtypes across rounds)"
+            )
+        if self.client_chunk_size is not None and self.client_chunk_size < 0:
+            raise ValueError(
+                "client_chunk_size must be positive, 0 (auto), or None"
             )
         if self.execution_mode.lower() not in ("vmap", "threaded"):
             raise ValueError(

@@ -349,6 +349,23 @@ def test_client_chunking_remainder_matches(tiny_config):
     np.testing.assert_allclose(b, a, atol=1e-5)
 
 
+def test_auto_chunk_size(tiny_config):
+    """client_chunk_size=0 resolves to a positive footprint-model estimate
+    (clamped to the cohort) and the run completes."""
+    cfg = dataclasses.replace(tiny_config, client_chunk_size=0, round=2)
+    res = run_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 2
+    # resolved into the result, NOT written back to the caller's config
+    # (a reused config with a different model must re-resolve auto)
+    assert cfg.client_chunk_size == 0
+    assert 1 <= res["client_chunk_size"] <= cfg.worker_number
+
+
+def test_negative_chunk_rejected(tiny_config):
+    with pytest.raises(ValueError, match="client_chunk_size"):
+        _run(tiny_config, client_chunk_size=-5)
+
+
 def test_all_empty_cohort_keeps_model(tiny_config, tiny_dataset):
     """A round whose every participant has zero samples (possible under
     extreme Dirichlet skew + sampling) must keep the previous global model,
