@@ -266,6 +266,18 @@ def test_bf16_local_compute_shapley_materialize_path(tiny_config):
     assert set(res["algorithm"].shapley_values) == {0, 1}
 
 
+def test_bf16_composes_with_fed_quant(tiny_config):
+    """bf16 local state + 8-bit quantized exchange (the two compression
+    layers compose: quantize computes in f32 internally, aggregation
+    accumulates f32)."""
+    res = _run(tiny_config, distributed_algorithm="fed_quant", round=3,
+               local_compute_dtype="bfloat16")
+    last = res["history"][-1]
+    assert last["test_accuracy"] > 0.2
+    assert 3.5 < last["uplink_compression_ratio"] < 4.1
+    assert last["client_eval"]["pre_agg_accuracy_mean"] > 0.1
+
+
 def test_bf16_requires_reset_optimizer(tiny_config):
     with pytest.raises(ValueError, match="reset_client_optimizer"):
         _run(tiny_config, local_compute_dtype="bfloat16",
