@@ -38,6 +38,9 @@ granularity for SignSGD — exactly like the reference workers.
 
 from __future__ import annotations
 
+import json
+import os
+import pickle
 import time
 from typing import Any
 
@@ -91,8 +94,6 @@ class _QueueServerBase:
         raise NotImplementedError
 
     def _broadcast(self, payload) -> None:
-        import pickle
-
         # Serialize once, enqueue the same bytes N times (a per-queue
         # put_result would re-pickle the full model per worker — per STEP
         # for sign_SGD).
@@ -181,8 +182,6 @@ class ThreadedServer(_QueueServerBase):
         }
         self.history.append(record)
         if self.metrics_path:
-            import json
-
             with open(self.metrics_path, "a") as f:
                 f.write(json.dumps(record) + "\n")
         get_logger().info(
@@ -310,8 +309,6 @@ class ThreadedSignSGDServer(_QueueServerBase):
             }
             self.history.append(record)
             if self.metrics_path:
-                import json
-
                 with open(self.metrics_path, "a") as f:
                     f.write(json.dumps(record) + "\n")
             get_logger().info(
@@ -440,8 +437,6 @@ def run_threaded_simulation(
     if setup_logging:
         # Same per-run artifact contract as the vmap path: a log file under
         # log/<algo>/<dataset>/<model>/ plus metrics.jsonl next to it.
-        import os
-
         log_path, log_dir = set_run_artifacts(
             config.log_root, config.distributed_algorithm,
             config.dataset_name, config.model_name,

@@ -111,6 +111,18 @@ def _sr_to_bf16(x32, salt):
     return rounded.astype(jnp.bfloat16), salt + jnp.uint32(0x9E3779B9)
 
 
+def _sr_tree_to_bf16(tree, salt):
+    """Stochastically round every leaf of an f32 pytree to bf16, threading
+    the dither salt through the leaves. Used for both SR sites (broadcast
+    cast and per-step param store)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for x in leaves:
+        r, salt = _sr_to_bf16(x.astype(jnp.float32), salt)
+        out.append(r)
+    return jax.tree_util.tree_unflatten(treedef, out), salt
+
+
 def make_local_train_fn(
     apply_fn,
     optimizer,
@@ -163,12 +175,7 @@ def make_local_train_fn(
             # resolution every round and progress below one bf16 ulp is
             # erased. With per-client SR the 1000-client aggregate
             # preserves the f32 global to ~ulp/sqrt(N).
-            leaves, treedef = jax.tree_util.tree_flatten(params)
-            cast = []
-            for p in leaves:
-                r, sr_state = _sr_to_bf16(p.astype(jnp.float32), sr_state)
-                cast.append(r)
-            params = jax.tree_util.tree_unflatten(treedef, cast)
+            params, sr_state = _sr_tree_to_bf16(params, sr_state)
         elif compute_dtype is not None:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(compute_dtype), params
@@ -215,14 +222,13 @@ def make_local_train_fn(
                     # f32 update math, stochastically-rounded bf16 storage:
                     # plain bf16 apply_updates swallows updates below the
                     # weight's bf16 ulp (see _sr_to_bf16).
-                    new_leaves = []
-                    leaves_p, treedef = jax.tree_util.tree_flatten(params)
-                    leaves_u = treedef.flatten_up_to(updates)
-                    for p, u in zip(leaves_p, leaves_u):
-                        x32 = p.astype(jnp.float32) + u.astype(jnp.float32)
-                        r, sr_state = _sr_to_bf16(x32, sr_state)
-                        new_leaves.append(r)
-                    params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+                    summed = jax.tree_util.tree_map(
+                        lambda p, u: (
+                            p.astype(jnp.float32) + u.astype(jnp.float32)
+                        ),
+                        params, updates,
+                    )
+                    params, sr_state = _sr_tree_to_bf16(summed, sr_state)
                 else:
                     params = optax.apply_updates(params, updates)
                 return (params, opt_state, sr_state), (loss, acc)

@@ -19,6 +19,7 @@ same program runs SPMD; weighted-mean/vote reductions become ICI collectives.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
@@ -268,9 +269,7 @@ def run_simulation(
         # would freeze this model's footprint-derived chunk into an object
         # the caller may reuse with a different model (where auto should
         # re-resolve). The resolved value is logged and in the result dict.
-        import dataclasses as _dc
-
-        config = _dc.replace(
+        config = dataclasses.replace(
             config,
             client_chunk_size=_auto_chunk_size(
                 config, global_params, n_clients
