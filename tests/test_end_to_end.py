@@ -419,6 +419,25 @@ def test_metrics_jsonl_written(tiny_config, tmp_path):
     assert {"round", "test_accuracy", "round_seconds"} <= set(lines[0])
 
 
+def test_metrics_jsonl_written_threaded_sign(tiny_config, tmp_path):
+    """The per-run artifact contract (log file + metrics.jsonl) holds in
+    threaded sign_SGD mode too — same layout as the vmap path."""
+    import glob
+    import json
+
+    cfg = dataclasses.replace(
+        tiny_config, log_root=str(tmp_path), distributed_algorithm="sign_SGD",
+        learning_rate=0.01, round=2, execution_mode="threaded",
+    )
+    run_simulation(cfg)
+    files = glob.glob(str(tmp_path / "**" / "metrics.jsonl"), recursive=True)
+    assert len(files) == 1
+    lines = [json.loads(line) for line in open(files[0])]
+    assert len(lines) == cfg.round
+    assert {"round", "test_accuracy", "uplink_compression_ratio",
+            "sync_steps"} <= set(lines[0])
+
+
 def test_heterogeneous_entry_point(tiny_config, tmp_path):
     import dataclasses
     from distributed_learning_simulator_tpu.simulator_heterogeneous import (
