@@ -1,0 +1,8 @@
+"""``python -m distributed_learning_simulator_tpu`` — same CLI as
+``python -m distributed_learning_simulator_tpu.simulator`` (the reference's
+``python3 simulator.py`` entry, reference simulator.sh:1)."""
+
+from distributed_learning_simulator_tpu.simulator import main
+
+if __name__ == "__main__":
+    main()

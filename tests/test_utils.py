@@ -131,3 +131,20 @@ def test_stochastic_round_decorrelated_across_salts():
     assert 0.2 < up1.mean() < 0.8
     assert 0.2 < up2.mean() < 0.8
     assert (up1 != up2).mean() > 0.2
+
+
+def test_package_main_entry_help():
+    """`python -m distributed_learning_simulator_tpu` exposes the same CLI
+    as the .simulator module (reference's `python3 simulator.py` entry)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_learning_simulator_tpu",
+         "--help"],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "--distributed_algorithm" in proc.stdout
