@@ -1,0 +1,10 @@
+#!/bin/bash
+# REAL pixels with zero network: scikit-learn's bundled handwritten-digits
+# images (1797 8x8 scans) as dataset `digits`. All five algorithms reach
+# 96-97% test accuracy on this config (docs/ACCURACY.md); swap
+# --distributed_algorithm to try the others (sign_SGD wants lr 0.01).
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name digits --model_name mlp \
+  --distributed_algorithm fed \
+  --worker_number 4 --round 10 --epoch 2 --learning_rate 0.1 \
+  --batch_size 25 --log_level INFO

@@ -317,6 +317,20 @@ def test_gtg_matches_exact_shapley(tiny_config):
         assert np.abs(ev - gv).max() < 0.05
 
 
+def test_cifar100_hundred_class_path(tiny_config):
+    """The 100-class registry entry plumbs num_classes through model
+    construction, eval, and the loss (loss under 100 classes starts near
+    ln(100) and must descend)."""
+    res = _run(
+        tiny_config, dataset_name="cifar100", model_name="cnn", round=2,
+        n_train=512, n_test=256, learning_rate=0.05,
+        dataset_args={"difficulty": 0.5},
+    )
+    losses = [h["test_loss"] for h in res["history"]]
+    assert losses[0] < 5.0  # near ln(100) ~ 4.6, not diverged
+    assert losses[-1] < losses[0]
+
+
 def test_dirichlet_partition_end_to_end(tiny_config):
     res = _run(tiny_config, partition="dirichlet", dirichlet_alpha=0.5,
                round=3)
