@@ -250,7 +250,10 @@ class GTGShapley(FedAvg):
         # when the largest of those k errors is within converge_criteria.
         # (NOT successive diffs: a running mean drifting steadily has small
         # per-step changes but large distance-to-final, and the reference
-        # keeps sampling in that regime.)
+        # keeps sampling in that regime.) Note the last_k window INCLUDES
+        # the final mean itself (its error is trivially 0, so last_k-1
+        # comparisons are informative) — that is the reference's exact
+        # slice, kept verbatim for parity.
         all_arr = np.stack(records)
         cumsum = np.cumsum(all_arr, axis=0)
         counts = np.arange(1, len(records) + 1)[:, None]

@@ -194,8 +194,12 @@ class ExperimentConfig:
         if not 0.0 <= self.trim_ratio < 0.5:
             raise ValueError("trim_ratio must be in [0, 0.5)")
         if self.aggregation.lower() == "trimmed_mean":
+            from distributed_learning_simulator_tpu.ops.aggregate import (
+                trim_count,
+            )
+
             cohort = self.cohort_size()
-            if int(self.trim_ratio * cohort) < 1:
+            if trim_count(cohort, self.trim_ratio) < 1:
                 raise ValueError(
                     f"trimmed_mean with trim_ratio={self.trim_ratio} and a "
                     f"cohort of {cohort} trims k=0 clients — a plain mean "
@@ -204,8 +208,12 @@ class ExperimentConfig:
                     "trim_ratio * cohort >= 1"
                 )
         if self.aggregation.lower() == "krum":
+            from distributed_learning_simulator_tpu.ops.aggregate import (
+                trim_count,
+            )
+
             cohort = self.cohort_size()
-            f = int(self.trim_ratio * cohort)
+            f = trim_count(cohort, self.trim_ratio)
             if cohort < 2 * f + 3:
                 raise ValueError(
                     f"krum needs n >= 2f + 3 participants (cohort={cohort}, "

@@ -83,12 +83,28 @@ class _QueueServerBase:
     worker_number: int
 
     def _init_queues(self) -> None:
+        self.server_error: BaseException | None = None
         self.result_queues = [
             NativeTaskQueue() for _ in range(self.worker_number)
         ]
         self.worker_data_queue = NativeTaskQueue(
-            worker_fun=self._process_worker_data
+            worker_fun=self._guarded_worker_fun
         )
+
+    def _guarded_worker_fun(self, data, extra_args):
+        """Server-callback errors must tear the rendezvous down, not kill
+        the serve thread silently: an eval OOM or a full disk inside
+        _process_worker_data would otherwise leave every worker blocked on
+        a broadcast that never comes (and the coordinator's progress poll
+        spinning forever). Record the error, stop every queue so blocked
+        workers unblock with 'queue is stopped', and let the coordinator
+        re-raise the ORIGINAL error."""
+        try:
+            return self._process_worker_data(data, extra_args)
+        except BaseException as e:  # noqa: BLE001 - re-raised by coordinator
+            self.server_error = e
+            self.stop()
+            return None
 
     def _process_worker_data(self, data, extra_args):  # pragma: no cover
         raise NotImplementedError
@@ -525,6 +541,11 @@ def run_threaded_simulation(
         if failed:
             server.stop()
         pool.join_pending()
+        if server.server_error is not None:
+            # A server-callback failure (eval OOM, full disk) tore the
+            # rendezvous down; the workers' queue-stopped errors are
+            # symptoms — surface the root cause.
+            raise server.server_error
         pool.results()  # re-raise any worker error
     finally:
         # Server first: pool.stop() joins pending work, and any worker

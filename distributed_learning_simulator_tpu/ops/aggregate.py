@@ -103,10 +103,32 @@ def coordinate_median(stacked_tree, weights=None):
     return jax.tree_util.tree_map(_leaf, stacked_tree)
 
 
+_TRIM_SCALE = 10_000  # trim ratios quantized to 1e-4 (see trim_count)
+
+
+def trim_count(m, trim_ratio: float):
+    """``floor(m * trim_ratio)`` with the ratio quantized to 1e-4, in ONE
+    integer formula shared by the static unweighted path, the traced
+    weighted path, Krum's Byzantine count, and config-time validation.
+
+    Why not plain float: the same ratio rounds differently in float32
+    (the traced path) and float64 (Python) — e.g. 0.29 * 100 is 28.999...
+    in f64 (int -> 28) but can land at 29.000001 in f32 (floor -> 29), so
+    two code paths would silently trim different client counts for the
+    same configuration. Integer math keeps every site in lockstep; the
+    scale stays small enough that ``m * q`` fits int32 for any realistic
+    cohort (m <= ~200k).
+    """
+    q = int(round(trim_ratio * _TRIM_SCALE))
+    if isinstance(m, int):
+        return (m * q) // _TRIM_SCALE
+    return (m.astype(jnp.int32) * q) // _TRIM_SCALE
+
+
 def trimmed_mean(stacked_tree, trim_ratio: float, weights=None):
     """Coordinate-wise trimmed mean: drop the k lowest and k highest values
-    per coordinate (k = floor(trim_ratio * m), m = participating clients),
-    average the rest.
+    per coordinate (k = floor(trim_ratio * m), m = participating clients,
+    computed by :func:`trim_count`), average the rest.
 
     Byzantine-robust for up to k adversarial clients. ``trim_ratio`` is
     static (part of the compiled program). Like :func:`coordinate_median`,
@@ -130,7 +152,7 @@ def trimmed_mean(stacked_tree, trim_ratio: float, weights=None):
 
         def _leaf(x):
             n = x.shape[0]
-            k = int(trim_ratio * n)
+            k = trim_count(n, trim_ratio)
             s = jnp.sort(x.astype(jnp.float32), axis=0)
             kept = s[k : n - k] if k else s
             return jnp.mean(kept, axis=0).astype(x.dtype)
@@ -143,7 +165,7 @@ def trimmed_mean(stacked_tree, trim_ratio: float, weights=None):
     # second jnp.where branch would double the sort cost of every round).
     valid = valid | ~jnp.any(valid)
     m = jnp.sum(valid.astype(jnp.int32))
-    k = jnp.floor(trim_ratio * m).astype(jnp.int32)
+    k = trim_count(m, trim_ratio)
 
     def _leaf_w(x):
         n = x.shape[0]
@@ -221,7 +243,7 @@ def aggregate(stacked_tree, weights, rule: str, trim_ratio: float = 0.1):
         return trimmed_mean(stacked_tree, trim_ratio, weights=weights)
     if rule == "krum":
         n = jax.tree_util.tree_leaves(stacked_tree)[0].shape[0]
-        return krum(stacked_tree, n_byzantine=int(trim_ratio * n),
+        return krum(stacked_tree, n_byzantine=trim_count(n, trim_ratio),
                     weights=weights)
     if rule == "mean":
         return weighted_mean(stacked_tree, weights)

@@ -193,8 +193,18 @@ class NativeTaskQueue:
         if not self._stopped:
             self._stopped = True
             self._lib.dlsq_stop(self._q)
-            if self._server_thread is not None:
-                self._server_thread.join(timeout=5)
+            if (
+                self._server_thread is not None
+                and self._server_thread is not threading.current_thread()
+            ):
+                # Full join, no timeout: dlsq_stop makes get_task return,
+                # so the serve thread exits as soon as the CURRENT callback
+                # finishes — which may be the final round's aggregation +
+                # evaluation. A timed join could return while that callback
+                # is still appending to history, silently losing the last
+                # round's record. (The current-thread guard lets a callback
+                # itself initiate shutdown on server-side errors.)
+                self._server_thread.join()
 
     def __del__(self):
         try:

@@ -299,3 +299,30 @@ def test_threaded_sign_sgd_momentum_matches_vmap(tiny_config):
     a_t = threaded["history"][-1]["test_accuracy"]
     a_v = vmapped["history"][-1]["test_accuracy"]
     assert abs(a_t - a_v) < 0.15, (a_t, a_v)
+
+
+def test_threaded_server_callback_failure_raises_not_hangs(tiny_config,
+                                                           monkeypatch):
+    """A server-callback failure (eval OOM, full disk) must tear the
+    rendezvous down and re-raise the ORIGINAL error — not kill the serve
+    thread silently and leave the coordinator spinning forever."""
+    import time as _time
+
+    import distributed_learning_simulator_tpu.execution.threaded as thr
+
+    original = thr.ThreadedServer._process_worker_data
+    calls = {"n": 0}
+
+    def sabotaged(self, data, extra_args):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("server eval exploded")
+        return original(self, data, extra_args)
+
+    monkeypatch.setattr(thr.ThreadedServer, "_process_worker_data",
+                        sabotaged)
+    cfg = dataclasses.replace(tiny_config, round=3)
+    t0 = _time.perf_counter()
+    with pytest.raises(RuntimeError, match="server eval exploded"):
+        thr.run_threaded_simulation(cfg, setup_logging=False)
+    assert _time.perf_counter() - t0 < 60

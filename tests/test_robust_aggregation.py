@@ -282,3 +282,33 @@ def test_threaded_robust_fallback_matches_vmap(tiny_config):
         )
     finally:
         server.stop()
+
+
+def test_trim_count_consistent_across_paths():
+    """The weighted (traced) and unweighted (static) trimmed-mean paths and
+    config validation must trim the SAME k for the same ratio — float32 vs
+    float64 representation of the ratio must never split them (e.g.
+    0.29 * 100 floors differently in f32 and f64)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.ops.aggregate import (
+        trim_count,
+        trimmed_mean,
+    )
+
+    for ratio, m in [(0.29, 100), (0.42, 150), (0.1, 8), (0.25, 12),
+                     (0.3333, 9)]:
+        k_static = trim_count(m, ratio)
+        k_traced = int(trim_count(jnp.asarray(m, jnp.int32), ratio))
+        assert k_static == k_traced, (ratio, m, k_static, k_traced)
+
+    # end-to-end: a stack where one extra trimmed client changes the result
+    rng = np.random.default_rng(0)
+    stack = {"w": jnp.asarray(rng.normal(size=(100, 7)), jnp.float32)}
+    ones = jnp.ones(100)
+    a = trimmed_mean(stack, 0.29)
+    b = trimmed_mean(stack, 0.29, weights=ones)
+    np.testing.assert_allclose(
+        np.asarray(a["w"]), np.asarray(b["w"]), atol=1e-5
+    )
