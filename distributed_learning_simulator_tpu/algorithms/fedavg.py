@@ -23,7 +23,10 @@ from distributed_learning_simulator_tpu.ops.aggregate import (
     aggregate,
     weighted_mean,
 )
-from distributed_learning_simulator_tpu.parallel.engine import make_local_train_fn
+from distributed_learning_simulator_tpu.parallel.engine import (
+    chunked_accumulate,
+    make_local_train_fn,
+)
 
 
 class FedAvg(Algorithm):
@@ -115,43 +118,22 @@ class FedAvg(Algorithm):
                 cp, ns, tm = train_clients(global_params, state, x, y, m, keys)
                 return reduce_chunk(cp, norm_w, payload_key), ns, tm
 
-            # Remainder participants (k % chunk) get their own vmap call so
+            # chunked_accumulate handles the reshape/scan/remainder
+            # discipline (remainder participants get their own vmap call so
             # the memory-safe path never silently degrades to materializing
-            # the full per-client param stack.
-            n_chunks, rem = divmod(k, chunk)
-            trees = (state, x, y, m, keys, norm_w)
-            head = jax.tree_util.tree_map(lambda a: a[: k - rem], trees)
-            resh = lambda a: a.reshape((n_chunks, chunk) + a.shape[1:])
-            xs = jax.tree_util.tree_map(resh, head)
-            payload_keys = jax.random.split(payload_key, n_chunks + 1)
-
-            def body(acc, args):
-                (state_c, x_c, y_c, m_c, keys_c, w_c), pk = args
+            # the full per-client param stack) and splits payload_key into
+            # per-chunk keys itself.
+            def compute(chunk_trees, pk):
+                state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
                 cp, ns, tm = vtrain(global_params, state_c, x_c, y_c, m_c,
                                     keys_c)
-                partial = reduce_chunk(cp, w_c, pk)
-                acc = jax.tree_util.tree_map(jnp.add, acc, partial)
-                return acc, (ns, tm)
+                return reduce_chunk(cp, w_c, pk), (ns, tm)
 
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, global_params)
-            agg, (ns, tm) = jax.lax.scan(
-                body, acc0, (xs, payload_keys[:n_chunks])
+            agg, (ns, tm) = chunked_accumulate(
+                (state, x, y, m, keys, norm_w), chunk, compute, acc0,
+                per_chunk=payload_key,
             )
-            unresh = lambda a: a.reshape((k - rem,) + a.shape[2:])
-            ns = jax.tree_util.tree_map(unresh, ns)
-            tm = jax.tree_util.tree_map(unresh, tm)
-            if rem:
-                state_t, x_t, y_t, m_t, keys_t, w_t = jax.tree_util.tree_map(
-                    lambda a: a[k - rem:], trees
-                )
-                cp_t, ns_t, tm_t = vtrain(global_params, state_t, x_t, y_t,
-                                          m_t, keys_t)
-                agg = jax.tree_util.tree_map(
-                    jnp.add, agg, reduce_chunk(cp_t, w_t, payload_keys[-1])
-                )
-                cat = lambda a, b: jnp.concatenate([a, b], axis=0)
-                ns = jax.tree_util.tree_map(cat, ns, ns_t)
-                tm = jax.tree_util.tree_map(cat, tm, tm_t)
             return agg, ns, tm
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):

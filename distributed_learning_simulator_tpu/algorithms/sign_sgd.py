@@ -34,7 +34,10 @@ from distributed_learning_simulator_tpu.ops.sign import (
     momentum_leaf,
     vote_apply_leaf,
 )
-from distributed_learning_simulator_tpu.parallel.engine import make_loss_fn
+from distributed_learning_simulator_tpu.parallel.engine import (
+    chunked_accumulate,
+    make_loss_fn,
+)
 
 
 class SignSGD(Algorithm):
@@ -175,29 +178,15 @@ class SignSGD(Algorithm):
                         # chunk-at-a-time; partial sign-sums accumulate into
                         # the vote so the full [n_clients, n_params] gradient
                         # stack never materializes (at 1000 clients x
-                        # ResNet-18 it would be ~44 GB). Remainder clients
-                        # (n % chunk) get their own call, same as fedavg's
-                        # train_and_reduce — any chunk size works.
-                        n_chunks, rem = divmod(n_clients, chunk)
-                        trees = (momenta, is_first, bx, by, bm)
-                        head = jax.tree_util.tree_map(
-                            lambda a: a[: n_clients - rem], trees
-                        )
-                        resh = lambda a: a.reshape(
-                            (n_chunks, chunk) + a.shape[1:]
-                        )
-                        xs = jax.tree_util.tree_map(resh, head)
-
-                        def body(acc, chunk_args):
-                            m_c, f_c, bx_c, by_c, bm_c = chunk_args
+                        # ResNet-18 it would be ~44 GB). chunked_accumulate
+                        # (parallel/engine.py) holds the reshape/scan/
+                        # remainder discipline — any chunk size works.
+                        def compute(chunk_trees, _pc):
+                            m_c, f_c, bx_c, by_c, bm_c = chunk_trees
                             partial, m_new, l_sum = chunk_compute(
                                 params, m_c, f_c, bx_c, by_c, bm_c
                             )
-                            acc_votes, acc_loss = acc
-                            acc_votes = jax.tree_util.tree_map(
-                                jnp.add, acc_votes, partial
-                            )
-                            return (acc_votes, acc_loss + l_sum), m_new
+                            return (partial, l_sum), m_new
 
                         acc0 = (
                             jax.tree_util.tree_map(
@@ -206,32 +195,12 @@ class SignSGD(Algorithm):
                             ),
                             jnp.float32(0.0),
                         )
-                        (vote_sum, loss_sum), m_stacked = jax.lax.scan(
-                            body, acc0, xs
+                        (vote_sum, loss_sum), momenta_new = (
+                            chunked_accumulate(
+                                (momenta, is_first, bx, by, bm), chunk,
+                                compute, acc0,
+                            )
                         )
-                        momenta_new = jax.tree_util.tree_map(
-                            lambda a: a.reshape(
-                                (n_clients - rem,) + a.shape[2:]
-                            ),
-                            m_stacked,
-                        )
-                        if rem:
-                            m_t, f_t, bx_t, by_t, bm_t = (
-                                jax.tree_util.tree_map(
-                                    lambda a: a[n_clients - rem:], trees
-                                )
-                            )
-                            partial_t, m_new_t, l_t = chunk_compute(
-                                params, m_t, f_t, bx_t, by_t, bm_t
-                            )
-                            vote_sum = jax.tree_util.tree_map(
-                                jnp.add, vote_sum, partial_t
-                            )
-                            loss_sum = loss_sum + l_t
-                            momenta_new = jax.tree_util.tree_map(
-                                lambda a, b: jnp.concatenate([a, b], axis=0),
-                                momenta_new, m_new_t,
-                            )
                     # sign of the summed signs: the majority vote
                     # (sign_sgd_server.py:16-18).
                     voted = jax.tree_util.tree_map(jnp.sign, vote_sum)

@@ -552,6 +552,14 @@ def run_threaded_simulation(
         # still blocked in get_result only unblocks once the queues stop.
         server.stop()
         pool.stop()
+    if server.server_error is not None:
+        # The FINAL round's aggregation/eval runs on the serve thread after
+        # every worker has already exited (workers end on add_task, not a
+        # blocking read), so a failure there surfaces only once
+        # server.stop() has joined the serve thread — i.e. here, after the
+        # finally. Without this re-check the run would return "success"
+        # with the last round's record silently missing.
+        raise server.server_error
     total = time.perf_counter() - t_start
     history = server.history
     n = client_data.n_clients

@@ -117,9 +117,13 @@ def trim_count(m, trim_ratio: float):
     two code paths would silently trim different client counts for the
     same configuration. Integer math keeps every site in lockstep; the
     scale stays small enough that ``m * q`` fits int32 for any realistic
-    cohort (m <= ~200k).
+    cohort (m <= ~200k). The quantization itself FLOORS (not rounds): a
+    half-up quantize would let trim_ratio just under 0.5 reach q = SCALE/2
+    and empty the trim window (m - 2k = 0) for even cohorts, breaking the
+    ``trim_ratio < 0.5  =>  m - 2k >= 1`` invariant the validation check
+    relies on.
     """
-    q = int(round(trim_ratio * _TRIM_SCALE))
+    q = int(trim_ratio * _TRIM_SCALE)
     if isinstance(m, int):
         return (m * q) // _TRIM_SCALE
     return (m.astype(jnp.int32) * q) // _TRIM_SCALE

@@ -254,6 +254,61 @@ def make_local_train_fn(
     return local_train
 
 
+def chunked_accumulate(trees, chunk: int, compute_fn, acc0, per_chunk=None):
+    """Sequential-over-chunks client scan with remainder handling — the ONE
+    copy of the slice/reshape/scan/concatenate discipline shared by the
+    FedAvg fused reduction (algorithms/fedavg.py train_and_reduce) and the
+    sign_SGD per-step vote (algorithms/sign_sgd.py): both bound HBM by
+    processing ``chunk`` clients at a time while accumulating a reduction,
+    and both must hand remainder clients (C % chunk) their own call so the
+    memory bound never silently degrades.
+
+    ``trees``: pytree of client-stacked arrays ``[C, ...]`` (None leaves
+    allowed — e.g. absent momentum buffers). ``per_chunk``: optional PRNG
+    key; the helper splits it into one key per chunk plus one for the
+    remainder call (splitting happens HERE so callers can't mis-size the
+    key array against this function's own chunk count).
+    ``compute_fn(chunk_trees, per_chunk_key) -> (partial, per_client)``:
+    ``partial`` is tree-added into ``acc0``; ``per_client`` (leading chunk
+    axis, None allowed) is restacked to ``[C, ...]``. Returns
+    ``(accumulated, per_client_full)``.
+    """
+    n = jax.tree_util.tree_leaves(trees)[0].shape[0]
+    n_chunks, rem = divmod(n, chunk)
+    head = jax.tree_util.tree_map(lambda a: a[: n - rem], trees)
+    xs = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]), head
+    )
+    keys = None
+    if per_chunk is not None:
+        keys = jax.random.split(per_chunk, n_chunks + 1)
+    scan_xs = xs if keys is None else (xs, keys[:n_chunks])
+
+    def body(acc, scan_in):
+        if per_chunk is None:
+            chunk_trees, pc = scan_in, None
+        else:
+            chunk_trees, pc = scan_in
+        partial, per_client = compute_fn(chunk_trees, pc)
+        return jax.tree_util.tree_map(jnp.add, acc, partial), per_client
+
+    acc, stacked = jax.lax.scan(body, acc0, scan_xs)
+    per_client = jax.tree_util.tree_map(
+        lambda a: a.reshape((n - rem,) + a.shape[2:]), stacked
+    )
+    if rem:
+        tail = jax.tree_util.tree_map(lambda a: a[n - rem:], trees)
+        partial_t, per_client_t = compute_fn(
+            tail, None if keys is None else keys[-1]
+        )
+        acc = jax.tree_util.tree_map(jnp.add, acc, partial_t)
+        per_client = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0),
+            per_client, per_client_t,
+        )
+    return acc, per_client
+
+
 def make_reshaper(sample_shape):
     """Batch preprocess for flattened eval storage: restore sample shape.
 

@@ -233,6 +233,31 @@ def test_threaded_worker_failure_raises_not_hangs(tiny_config, monkeypatch):
     assert _time.perf_counter() - t0 < 60  # promptly, not a hang
 
 
+def test_threaded_server_final_callback_failure_raises(tiny_config,
+                                                       monkeypatch):
+    """A failure in the LAST round's server callback happens after every
+    worker has exited (workers end on add_task), so it only surfaces once
+    stop() joins the serve thread — the run must still re-raise it rather
+    than return success with the final record missing."""
+    import distributed_learning_simulator_tpu.execution.threaded as thr
+
+    cfg = dataclasses.replace(tiny_config, round=2)
+    original = thr.ThreadedServer._process_worker_data
+    total_uploads = cfg.round * cfg.worker_number
+    calls = {"n": 0}
+
+    def sabotaged(self, data, extra_args):
+        calls["n"] += 1
+        if calls["n"] == total_uploads:  # the barrier-completing last upload
+            raise RuntimeError("final eval exploded")
+        return original(self, data, extra_args)
+
+    monkeypatch.setattr(thr.ThreadedServer, "_process_worker_data",
+                        sabotaged)
+    with pytest.raises(RuntimeError, match="final eval exploded"):
+        thr.run_threaded_simulation(cfg, setup_logging=False)
+
+
 def test_threaded_fed_matches_vmap(tiny_config):
     """Differential oracle for FedAvg: thread-per-client over the native
     queue vs the fused vmap round program must agree statistically
