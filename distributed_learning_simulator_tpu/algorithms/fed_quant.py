@@ -31,7 +31,6 @@ compression-ratio reporting. Here:
 from __future__ import annotations
 
 import jax
-import numpy as np
 
 from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
 from distributed_learning_simulator_tpu.ops.payload import (
@@ -49,21 +48,9 @@ from distributed_learning_simulator_tpu.ops.quantize import (
 class FedQuant(FedAvg):
     name = "fed_quant"
 
-    def __init__(self, config):
-        super().__init__(config)
-        # Pre-aggregation per-client eval reads the stacked client params
-        # from the round output, i.e. forces the materializing path; auto
-        # (None) enables it only at reference-like cohort sizes so large
-        # cohorts keep the fused memory-bounded aggregation.
-        ce = getattr(config, "client_eval", None)
-        if ce is None:
-            ce = config.cohort_size() <= 32
-        self.keep_client_params = bool(ce)
-        self._eval_fn = None
-        self._client_eval_jit = None
-
-    def prepare(self, apply_fn, eval_fn):
-        self._eval_fn = eval_fn
+    # Per-client eval telemetry (reference fed_quant_worker.py:55-69) is
+    # FedAvg-family machinery now — FedAvg.__init__ auto-enables it for
+    # this algorithm at reference-like cohort sizes.
 
     @property
     def levels(self) -> int:
@@ -104,31 +91,5 @@ class FedQuant(FedAvg):
             "payload_bytes_raw": raw,
             "payload_bytes_quantized": comp,
         }
-        client_params = ctx.aux.get("client_params")
-        if self.keep_client_params and client_params is not None:
-            if self._client_eval_jit is None:
-                # One inference program evaluates every client's model: vmap
-                # over the stacked params, the padded test batches broadcast.
-                in_axes = (0,) + (None,) * len(ctx.eval_batches)
-                self._client_eval_jit = jax.jit(
-                    jax.vmap(self._eval_fn, in_axes=in_axes)
-                )
-            m = self._client_eval_jit(client_params, *ctx.eval_batches)
-            accs = np.asarray(m["accuracy"], dtype=np.float64)
-            out["client_eval"] = {
-                "pre_agg_accuracy_mean": float(accs.mean()),
-                "pre_agg_accuracy_min": float(accs.min()),
-                "pre_agg_accuracy_max": float(accs.max()),
-                "post_agg_accuracy": float(ctx.metrics["accuracy"]),
-            }
-            from distributed_learning_simulator_tpu.utils.logging import (
-                get_logger,
-            )
-
-            get_logger().info(
-                "round %d: pre-agg client acc mean=%.4f min=%.4f max=%.4f; "
-                "post-agg global acc=%.4f",
-                ctx.round_idx, accs.mean(), accs.min(), accs.max(),
-                ctx.metrics["accuracy"],
-            )
+        out.update(super().post_round(ctx))  # client_eval telemetry
         return out

@@ -32,11 +32,65 @@ from distributed_learning_simulator_tpu.parallel.engine import (
 class FedAvg(Algorithm):
     name = "fed"
 
+    def __init__(self, config):
+        super().__init__(config)
+        # Per-round per-client evaluation (config.client_eval): every
+        # client's uploaded model evaluated on the test set BEFORE
+        # aggregation, plus the post-aggregation global accuracy — the
+        # reference logs this for fed_quant (fed_quant_worker.py:55-69);
+        # here it is FedAvg-family machinery any subclass can enable.
+        # None = auto: on only for fed_quant at reference-like cohort
+        # sizes (<= 32); explicit True forces it (and the materializing
+        # path), False disables.
+        ce = getattr(config, "client_eval", None)
+        if ce is None:
+            ce = self.name == "fed_quant" and config.cohort_size() <= 32
+        self._client_eval_enabled = bool(ce)
+        if self._client_eval_enabled:
+            self.keep_client_params = True
+        self._eval_fn = None
+        self._client_eval_jit = None
+
+    def prepare(self, apply_fn, eval_fn):
+        self._eval_fn = eval_fn
+
     # jax-level template hooks, parity with fed_server.py:38-42 -------------
     def process_client_payload(self, client_params, key):
         """Per-client payload transform before aggregation (identity here;
         FedQuant overrides with quantize->dequantize)."""
         return client_params, {}
+
+    def post_round(self, ctx):
+        client_params = ctx.aux.get("client_params")
+        if not self._client_eval_enabled or client_params is None:
+            return {}
+        import numpy as np
+
+        if self._client_eval_jit is None:
+            # One inference program evaluates every client's model: vmap
+            # over the stacked params, the padded test batches broadcast.
+            in_axes = (0,) + (None,) * len(ctx.eval_batches)
+            self._client_eval_jit = jax.jit(
+                jax.vmap(self._eval_fn, in_axes=in_axes)
+            )
+        m = self._client_eval_jit(client_params, *ctx.eval_batches)
+        accs = np.asarray(m["accuracy"], dtype=np.float64)
+        from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+        get_logger().info(
+            "round %d: pre-agg client acc mean=%.4f min=%.4f max=%.4f; "
+            "post-agg global acc=%.4f",
+            ctx.round_idx, accs.mean(), accs.min(), accs.max(),
+            ctx.metrics["accuracy"],
+        )
+        return {
+            "client_eval": {
+                "pre_agg_accuracy_mean": float(accs.mean()),
+                "pre_agg_accuracy_min": float(accs.min()),
+                "pre_agg_accuracy_max": float(accs.max()),
+                "post_agg_accuracy": float(ctx.metrics["accuracy"]),
+            }
+        }
 
     def process_aggregated(self, global_params, key):
         """Aggregated-params transform (identity; FedQuant quantizes the

@@ -90,14 +90,16 @@ class ExperimentConfig:
     # --- quantization (algorithms/fed_quant.py) ----------------------------
     quant_levels: int = 256
     qat: bool = True
-    # Per-round per-client local evaluation (fed_quant only): every client's
-    # uploaded model is evaluated on the test set BEFORE aggregation, with
-    # the post-aggregation global accuracy logged alongside — parity with
-    # reference workers/fed_quant_worker.py:55-69. Requires materializing
-    # the per-client parameter stack (the fused memory-bounded aggregation
-    # path can't serve it), so None = auto: on for cohorts <= 32 (the
-    # reference ran 4-8 workers), off above, preserving the large-cohort
-    # memory envelope. Explicit True/False overrides.
+    # Per-round per-client local evaluation (FedAvg family: fed,
+    # fed_quant): every client's uploaded model is evaluated on the test
+    # set BEFORE aggregation, with the post-aggregation global accuracy
+    # logged alongside — parity with reference
+    # workers/fed_quant_worker.py:55-69. Requires materializing the
+    # per-client parameter stack (the fused memory-bounded aggregation
+    # path can't serve it), so None = auto: on for fed_quant at cohorts
+    # <= 32 (the reference ran 4-8 workers), off otherwise, preserving the
+    # large-cohort memory envelope. Explicit True forces it on (fed too);
+    # False disables; True with other algorithms is rejected.
     client_eval: bool | None = None
 
     # --- Shapley (algorithms/shapley.py) ------------------------------------
@@ -233,6 +235,18 @@ class ExperimentConfig:
                 "local_compute_dtype='bfloat16' requires "
                 "reset_client_optimizer=True (persistent per-client "
                 "optimizer state is f32 and would mix dtypes across rounds)"
+            )
+        if (
+            self.client_eval is True
+            and self.distributed_algorithm not in ("fed", "fed_quant")
+        ):
+            # Reject rather than silently ignore: the telemetry machinery
+            # lives in the FedAvg round/post_round pair; the Shapley
+            # servers override post_round entirely and sign_SGD keeps one
+            # shared params tree (there is no per-client model to score).
+            raise ValueError(
+                "client_eval=True is only supported for the FedAvg family "
+                f"(fed, fed_quant), not {self.distributed_algorithm!r}"
             )
         if self.client_chunk_size is not None and self.client_chunk_size < 0:
             raise ValueError(

@@ -178,11 +178,14 @@ class ThreadedServer(_QueueServerBase):
             # Same finite-or-previous-model guard as the vmap path
             # (fedavg.py round_fn): an all-diverged cohort must not poison
             # the global model — the two execution modes are a differential
-            # oracle pair and must agree in exactly these scenarios.
-            finite = all(
-                bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32))))
+            # oracle pair and must agree in exactly these scenarios. One
+            # fused reduction + one device sync (a per-leaf bool() would
+            # pay L round-trips per round, and params are normally finite
+            # so every leaf would be fetched).
+            finite = bool(jnp.all(jnp.stack([
+                jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
                 for leaf in jax.tree_util.tree_leaves(aggregated)
-            )
+            ])))
             if not finite:
                 aggregated = self.prev_model
         aggregated = self._process_aggregated_parameter(aggregated)

@@ -157,6 +157,9 @@ def trimmed_mean(stacked_tree, trim_ratio: float, weights=None):
         def _leaf(x):
             n = x.shape[0]
             k = trim_count(n, trim_ratio)
+            if trim_ratio > 0.0:
+                # Same at-least-one-trim clamp as the weighted path below.
+                k = min(max(k, 1), (n - 1) // 2)
             s = jnp.sort(x.astype(jnp.float32), axis=0)
             kept = s[k : n - k] if k else s
             return jnp.mean(kept, axis=0).astype(x.dtype)
@@ -169,7 +172,16 @@ def trimmed_mean(stacked_tree, trim_ratio: float, weights=None):
     # second jnp.where branch would double the sort cost of every round).
     valid = valid | ~jnp.any(valid)
     m = jnp.sum(valid.astype(jnp.int32))
+    # k from the RUNTIME participating count, clamped: validate() only
+    # guarantees k >= 1 for the configured cohort, but m can shrink below
+    # it at round time (empty Dirichlet shards) until trim_count floors to
+    # 0 — a plain mean with zero robustness that a single finite-but-huge
+    # Byzantine upload would shift arbitrarily. Keep at least one trim
+    # whenever a ratio was asked for AND the window survives
+    # (k <= (m-1)//2 keeps m - 2k >= 1; for m <= 2 no trim is possible).
     k = trim_count(m, trim_ratio)
+    if trim_ratio > 0.0:
+        k = jnp.clip(jnp.maximum(k, 1), 0, (m - 1) // 2)
 
     def _leaf_w(x):
         n = x.shape[0]

@@ -14,6 +14,12 @@ import jax
 
 from distributed_learning_simulator_tpu.utils.logging import get_logger
 
+# Coordinator this process successfully initialized against (None when
+# jax.distributed was brought up elsewhere or auto-configured) — the JAX
+# API doesn't expose it, so remember it to catch a re-call that names a
+# DIFFERENT coordinator while counts happen to match.
+_initialized_coordinator: str | None = None
+
 
 def initialize_multihost(
     coordinator_address: str | None = None,
@@ -32,6 +38,8 @@ def initialize_multihost(
     two independent single-process runs that each write a full set of
     artifacts.
     """
+    global _initialized_coordinator
+
     logger = get_logger()
     explicit = any(
         v is not None
@@ -58,6 +66,24 @@ def initialize_multihost(
                     f"(num_processes={num_processes}, "
                     f"process_id={process_id}); refusing to proceed"
                 )
+            if coordinator_address is not None:
+                if (
+                    _initialized_coordinator is not None
+                    and _initialized_coordinator != coordinator_address
+                ):
+                    raise RuntimeError(
+                        "jax.distributed is already initialized against "
+                        f"coordinator {_initialized_coordinator!r} but the "
+                        f"caller asked for {coordinator_address!r}; "
+                        "refusing to silently reuse a different cluster"
+                    )
+                if _initialized_coordinator is None:
+                    logger.warning(
+                        "jax.distributed was initialized outside "
+                        "initialize_multihost; cannot verify it points at "
+                        "the requested coordinator %r",
+                        coordinator_address,
+                    )
         logger.info("jax.distributed already initialized; reusing it")
     else:
         try:
@@ -66,6 +92,8 @@ def initialize_multihost(
                 num_processes=num_processes,
                 process_id=process_id,
             )
+            if coordinator_address is not None:
+                _initialized_coordinator = coordinator_address
         except (RuntimeError, ValueError) as e:
             # No coordinator configured and none auto-detectable (plain
             # single-process environment).

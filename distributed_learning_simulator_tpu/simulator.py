@@ -222,6 +222,15 @@ def run_simulation(
         # for no caching.
         jax.config.update("jax_compilation_cache_dir", None)
     if config.execution_mode.lower() == "threaded":
+        if config.multihost:
+            # The thread-per-client mode has no multi-process awareness;
+            # each process would independently train ALL clients and write
+            # a full artifact set — the silent split initialize_multihost's
+            # contract forbids.
+            raise ValueError(
+                "execution_mode='threaded' does not support multihost; "
+                "use the vmap execution mode"
+            )
         # Honor the flag from EVERY entry point (heterogeneous CLI, bench,
         # programmatic callers), not just simulator.main.
         from distributed_learning_simulator_tpu.execution.threaded import (
@@ -234,7 +243,15 @@ def run_simulation(
         )
     logger = get_logger()
     set_level(config.log_level)
+    # Multi-process SPMD runs one identical program per process; artifacts
+    # (log file, metrics.jsonl, checkpoints) are written by process 0 only
+    # — every process writing the same timestamped paths would interleave
+    # log lines, duplicate every metrics record, and race checkpoint
+    # writes into torn files.
+    is_primary = jax.process_index() == 0
     log_dir = None
+    if setup_logging and not is_primary:
+        setup_logging = False
     if setup_logging:
         # Per-run artifact dir: Shapley metric pickles etc. go here so
         # concurrent/subsequent runs never overwrite each other's artifacts.
@@ -434,7 +451,9 @@ def run_simulation(
     # nor when checkpointing needs per-client or server-optimizer state (those
     # buffers are donated to round r+1's dispatch before round r's deferred
     # checkpoint would read them).
-    checkpointing = bool(config.checkpoint_dir and config.checkpoint_every)
+    checkpointing = bool(
+        config.checkpoint_dir and config.checkpoint_every and is_primary
+    )
     pipelined = (
         config.pipeline_rounds
         and algorithm.supports_round_pipelining

@@ -218,6 +218,28 @@ def test_fed_quant_client_eval_vmap_matches_individual(tiny_config):
     np.testing.assert_allclose(accs[0], float(single["accuracy"]), atol=1e-6)
 
 
+def test_fed_client_eval_opt_in(tiny_config):
+    """client_eval=True works for plain FedAvg too (the telemetry is
+    FedAvg-family machinery, not fed_quant-specific)."""
+    res = _run(tiny_config, round=2, client_eval=True)
+    for h in res["history"]:
+        ce = h["client_eval"]
+        assert 0.0 <= ce["pre_agg_accuracy_mean"] <= 1.0
+        assert ce["post_agg_accuracy"] == h["test_accuracy"]
+    # auto (None) keeps plain fed on the fused path: no telemetry
+    res2 = _run(tiny_config, round=1)
+    assert "client_eval" not in res2["history"][0]
+
+
+def test_client_eval_rejected_outside_fedavg_family(tiny_config):
+    with pytest.raises(ValueError, match="client_eval"):
+        _run(tiny_config, distributed_algorithm="sign_SGD",
+             client_eval=True)
+    with pytest.raises(ValueError, match="client_eval"):
+        _run(tiny_config, distributed_algorithm="multiround_shapley_value",
+             client_eval=True)
+
+
 def test_fed_quant_client_eval_auto_disables_large_cohort(tiny_config):
     """client_eval=None (auto) must keep the fused memory-bounded path for
     large cohorts: no telemetry above the auto threshold."""
