@@ -45,6 +45,17 @@ class FedAvg(Algorithm):
         ce = getattr(config, "client_eval", None)
         if ce is None:
             ce = self.name == "fed_quant" and config.cohort_size() <= 32
+            if self.name == "fed_quant" and not ce:
+                from distributed_learning_simulator_tpu.utils.logging import (
+                    get_logger,
+                )
+
+                get_logger().info(
+                    "client_eval auto-disabled: cohort size %d > 32 (the "
+                    "per-client eval needs the materializing path); pass "
+                    "client_eval=True to force it",
+                    config.cohort_size(),
+                )
         self._client_eval_enabled = bool(ce)
         if self._client_eval_enabled:
             self.keep_client_params = True

@@ -285,7 +285,12 @@ class GTGShapley(FedAvg):
         memo: dict[frozenset, float] = {}
 
         def utilities_for(masks_sets: list[frozenset]) -> None:
-            todo = [s for s in masks_sets if s not in memo]
+            # dict.fromkeys: wave batching legitimately requests the same
+            # prefix from many permutations (e.g. every permutation's full
+            # set) — evaluate each subset once, not once per requester.
+            todo = list(dict.fromkeys(
+                s for s in masks_sets if s not in memo
+            ))
             if not todo:
                 return
             mask_rows = np.zeros((len(todo), n), dtype=np.float32)
@@ -303,37 +308,61 @@ class GTGShapley(FedAvg):
         n_perms = 0
         converged = False
         while not converged and n_perms < self.max_permutations:
-            # One permutation starting with each worker (:42-49).
+            # One permutation starting with each worker (:42-49). The whole
+            # sampling iteration is evaluated in shared WAVES: wave w
+            # requests prefix block [wB, wB+B) for EVERY still-active
+            # permutation in one batched evaluator call (the memo dedups
+            # shared prefixes), instead of walking the n permutations one
+            # at a time — at N=128 this cuts the sequential host
+            # dispatch+fetch cycles per iteration from O(n * n/B) to n/B.
+            # The per-permutation walk (eps-truncation semantics :51-61,
+            # truncated step keeps v_prev so its marginal is exactly 0) is
+            # unchanged, so within one sampling iteration the records — and
+            # therefore SVs, permutation counts and the convergence point —
+            # match a sequential walk over the same permutations. Two
+            # bookkeeping differences vs walking one permutation at a time:
+            # prefixes evaluated past a mid-iteration convergence are extra
+            # (they land in the memo/metric pickle), and all n shuffles are
+            # drawn up front, so on mid-iteration convergence the RNG
+            # stream position differs from a lazily-drawing walk (later
+            # rounds sample different — equally valid — permutations).
+            perms = []
             for first in range(n):
                 rest = [i for i in range(n) if i != first]
                 self._rng.shuffle(rest)
-                perm = [first] + rest
-                prefixes = [
-                    frozenset(perm[: j + 1]) for j in range(n)
-                ]
-                # Prefix utilities are fetched lazily in fused blocks: one
-                # batched call per _PREFIX_BLOCK prefixes, and the walk
-                # stops requesting blocks once eps-truncated (:51-61) — the
-                # reference's lazy skip, without its N sequential host
-                # round-trips. A truncated step keeps v_prev, so its
-                # marginal contribution is exactly 0.
-                marginal = np.zeros(n, dtype=np.float64)
-                v_prev = memo[frozenset()]
-                j = 0
-                while j < n:
-                    if abs(metric_now - v_prev) < self.eps:
-                        break  # truncated: remaining marginals stay 0
-                    block = prefixes[j : j + _PREFIX_BLOCK]
-                    utilities_for(block)
-                    for prefix in block:
-                        if abs(metric_now - v_prev) >= self.eps:
-                            v_j = memo[prefix]
+                perms.append([first] + rest)
+            marginals = np.zeros((n, n), dtype=np.float64)
+            v_prev = [memo[frozenset()]] * n
+            truncated = [False] * n
+            for j0 in range(0, n, _PREFIX_BLOCK):
+                j1 = min(j0 + _PREFIX_BLOCK, n)
+                wave: list[frozenset] = []
+                for p_idx, perm in enumerate(perms):
+                    if truncated[p_idx] or (
+                        abs(metric_now - v_prev[p_idx]) < self.eps
+                    ):
+                        truncated[p_idx] = True
+                        continue
+                    wave.extend(
+                        frozenset(perm[: j + 1]) for j in range(j0, j1)
+                    )
+                if not wave:
+                    break  # every permutation truncated
+                utilities_for(wave)
+                for p_idx, perm in enumerate(perms):
+                    if truncated[p_idx]:
+                        continue
+                    vp = v_prev[p_idx]
+                    for j in range(j0, j1):
+                        if abs(metric_now - vp) >= self.eps:
+                            v_j = memo[frozenset(perm[: j + 1])]
                         else:
-                            v_j = v_prev
-                        marginal[perm[j]] = v_j - v_prev
-                        v_prev = v_j
-                        j += 1
-                records.append(marginal.copy())  # copy: fixes SURVEY 2.1#10
+                            v_j = vp  # truncated: marginal exactly 0
+                        marginals[p_idx, perm[j]] = v_j - vp
+                        vp = v_j
+                    v_prev[p_idx] = vp
+            for p_idx in range(n):
+                records.append(marginals[p_idx].copy())  # SURVEY 2.1#10
                 n_perms += 1
                 if self._converged(records, n):
                     converged = True

@@ -114,14 +114,16 @@ class _QueueServerBase:
         # put_result would re-pickle the full model per worker — per STEP
         # for sign_SGD).
         blob = pickle.dumps(payload)
-        try:
-            for q in self.result_queues:
+        for q in self.result_queues:
+            try:
                 q.put_result_pickled(blob)
-        except RuntimeError:
-            # stop() raced the final broadcast; nobody is listening. The
-            # old RepeatedResult path got this guard from the queue's
-            # _serve loop — replicate it here.
-            pass
+            except RuntimeError:
+                # Swallow ONLY the stopped-queue race (stop() raced the
+                # final broadcast; nobody is listening). Any other enqueue
+                # failure would leave some workers with the payload and
+                # others without — that must propagate, not vanish.
+                if not q.stopped:
+                    raise
 
     def stop(self):
         self.worker_data_queue.stop()
@@ -154,6 +156,12 @@ class ThreadedServer(_QueueServerBase):
 
     def _process_aggregated_parameter(self, params):
         return params
+
+    def _record_extra(self, aggregated) -> dict:
+        """Algorithm-specific per-round history fields (FedQuant adds its
+        compression telemetry here)."""
+        del aggregated
+        return {}
 
     def _process_worker_data(self, data, extra_args):
         del extra_args
@@ -198,6 +206,7 @@ class ThreadedServer(_QueueServerBase):
             "test_accuracy": metrics["accuracy"],
             "test_loss": metrics["loss"],
             "round_seconds": time.perf_counter() - self._round_t0,
+            **self._record_extra(aggregated),
         }
         self.history.append(record)
         if self.metrics_path:
@@ -236,14 +245,102 @@ class ThreadedWorker:
             # Block for the current global model (fed_worker.py:22,37).
             params = self.result_queue.get_result()
             params = jax.tree_util.tree_map(jnp.asarray, params)
-            key, round_key = jax.random.split(key)
+            key, round_key, upload_key = jax.random.split(key, 3)
             new_params, _, _ = self._local_train(
                 params, None, xs, ys, mask, round_key
             )
             # Upload (worker_id, |D_i|, params) (fed_worker.py:28-35).
-            self.queue.add_task(
-                (self.worker_id, size, jax.device_get(new_params))
-            )
+            self.queue.add_task((
+                self.worker_id, size,
+                jax.device_get(self._upload_payload(new_params, upload_key)),
+            ))
+
+    def _upload_payload(self, new_params, key):
+        """Uplink transform hook (identity; FedQuant quantizes)."""
+        del key
+        return new_params
+
+
+class ThreadedFedQuantServer(ThreadedServer):
+    """Quantized-exchange FedAvg through the queue architecture (reference
+    servers/fed_quant_server.py): clients upload 8-bit stochastically
+    quantized params, the server dequantizes each upload before the
+    weighted mean (:25-33), re-quantizes the aggregate for the downlink
+    (:35-50), and reports the compression ratio per round. The quantize/
+    dequantize math is ops/quantize.py — the single source shared with the
+    vmap FedQuant, so the two execution modes form a differential oracle
+    for the quantized exchange path.
+
+    The downlink broadcast carries the DEQUANTIZED values: in the reference
+    too, dequantization runs in server code when the worker calls
+    ``get_parameter_dict()`` over shared memory (fed_quant_server.py:20-24)
+    — the quantized pair never crosses a wire the worker decodes itself."""
+
+    def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
+                 init_params_tree, metrics_path: str | None = None):
+        from distributed_learning_simulator_tpu.ops.quantize import (
+            dequantize_tree,
+            stochastic_quantize_tree,
+        )
+
+        self._levels = getattr(config, "quant_levels", 256)
+        self._quant_key = jax.random.key(config.seed + 9973)
+        self._dequantize_tree = dequantize_tree
+        self._quantize_tree = stochastic_quantize_tree
+        super().__init__(config, evaluate, eval_batches, init_params_tree,
+                         metrics_path=metrics_path)
+
+    def _process_client_parameter(self, worker_id: int, params):
+        # Uplink: the client sent QuantizedTensor leaves; reconstruct f32
+        # values before aggregation (fed_quant_server.py:25-33).
+        del worker_id
+        return self._dequantize_tree(params)
+
+    def _process_aggregated_parameter(self, params):
+        # Downlink: unbiased stochastic re-quantization of the aggregate
+        # (fed_quant_server.py:35-39), dequantized for the broadcast.
+        self._quant_key, k = jax.random.split(self._quant_key)
+        return self._dequantize_tree(
+            self._quantize_tree(params, self._levels, k)
+        )
+
+    def _record_extra(self, aggregated) -> dict:
+        # Analytic compression telemetry, same fields as the vmap FedQuant's
+        # post_round (parity with the serialized-size logs at
+        # fed_quant_server.py:41-48).
+        from distributed_learning_simulator_tpu.ops.payload import (
+            compression_ratio,
+            payload_bytes,
+            quantized_payload_bytes,
+        )
+
+        raw = payload_bytes(aggregated)
+        comp = quantized_payload_bytes(aggregated, self._levels)
+        ratio = compression_ratio(raw, comp)
+        return {
+            "uplink_compression_ratio": ratio,
+            "downlink_compression_ratio": ratio,
+        }
+
+
+class ThreadedFedQuantWorker(ThreadedWorker):
+    """FedQuant client thread: QAT local training (the shared jitted
+    local_train carries the fake-quant param transform), then a genuinely
+    quantized upload — the payload on the uplink queue is the
+    QuantizedTensor tree, decoded server-side (reference
+    fed_quant_worker.py:36-53 sends the QAT-quantized parameter dict)."""
+
+    def __init__(self, *args, levels: int = 256):
+        super().__init__(*args)
+        self._levels = levels
+        from distributed_learning_simulator_tpu.ops.quantize import (
+            stochastic_quantize_tree,
+        )
+
+        self._quantize_tree = stochastic_quantize_tree
+
+    def _upload_payload(self, new_params, key):
+        return self._quantize_tree(new_params, self._levels, key)
 
 
 class ThreadedSignSGDServer(_QueueServerBase):
@@ -406,10 +503,10 @@ def run_threaded_simulation(
 
     config.validate()
     algo_name = config.distributed_algorithm
-    if algo_name not in ("fed", "sign_SGD"):
+    if algo_name not in ("fed", "sign_SGD", "fed_quant"):
         raise ValueError(
-            "threaded execution mode supports algorithms 'fed' and "
-            f"'sign_SGD', not {algo_name!r}"
+            "threaded execution mode supports algorithms 'fed', 'sign_SGD' "
+            f"and 'fed_quant', not {algo_name!r}"
         )
     if algo_name == "sign_SGD":
         # Constructor runs the sign_SGD config validation (requires SGD,
@@ -445,6 +542,36 @@ def run_threaded_simulation(
         raise ValueError(
             "threaded execution mode does not support local_compute_dtype="
             f"{config.local_compute_dtype!r}; use the vmap execution mode"
+        )
+    if config.client_eval is True:
+        # The per-client pre-aggregation telemetry is produced by the vmap
+        # path's stacked client params; silently running without it would
+        # drop promised metrics.
+        raise ValueError(
+            "threaded execution mode does not support client_eval=True; "
+            "use the vmap execution mode"
+        )
+    if (
+        config.client_eval is None
+        and algo_name == "fed_quant"
+        and config.cohort_size() <= 32
+    ):
+        # vmap fed_quant auto-enables per-client eval at this cohort size;
+        # announce the degradation instead of silently omitting telemetry
+        # the other execution mode would have produced.
+        get_logger().info(
+            "threaded mode does not produce client_eval telemetry (the "
+            "vmap execution mode auto-enables it for fed_quant at cohort "
+            "size %d)", config.cohort_size(),
+        )
+    if config.multihost:
+        # Enforced at every entry point, not only run_simulation's dispatch:
+        # a direct programmatic call would otherwise run one full independent
+        # simulation PER process — the silent split the multihost contract
+        # forbids.
+        raise ValueError(
+            "execution_mode='threaded' does not support multihost; "
+            "use the vmap execution mode"
         )
     from distributed_learning_simulator_tpu.utils.logging import (
         set_level,
@@ -502,23 +629,47 @@ def run_threaded_simulation(
             client_data, metrics_path,
         )
     else:
+        param_transform = None
+        if algo_name == "fed_quant" and getattr(config, "qat", True):
+            # QAT: straight-through fake-quant on params inside the loss —
+            # the same transform the vmap FedQuant installs
+            # (algorithms/fed_quant.py client_param_transform).
+            from distributed_learning_simulator_tpu.ops.quantize import (
+                fake_quant_tree,
+            )
+
+            levels = getattr(config, "quant_levels", 256)
+            param_transform = lambda p: fake_quant_tree(p, levels)  # noqa: E731
         local_train = jax.jit(
             make_local_train_fn(
                 model.apply, optimizer, local_epochs=config.epoch,
                 batch_size=config.batch_size, reset_optimizer=True,
                 preprocess=decoder,
                 augment=get_augment(config.augment),
+                param_transform=param_transform,
             )
         )
-        server = ThreadedServer(config, evaluate, eval_batches, params,
-                                metrics_path=metrics_path)
+        if algo_name == "fed_quant":
+            server = ThreadedFedQuantServer(config, evaluate, eval_batches,
+                                            params, metrics_path=metrics_path)
+            q_levels = getattr(config, "quant_levels", 256)
 
-        def make_worker(worker_id, shard):
-            return ThreadedWorker(
-                worker_id, server.worker_data_queue,
-                server.result_queues[worker_id], local_train, shard,
-                config.round, config.seed,
-            )
+            def make_worker(worker_id, shard):
+                return ThreadedFedQuantWorker(
+                    worker_id, server.worker_data_queue,
+                    server.result_queues[worker_id], local_train, shard,
+                    config.round, config.seed, levels=q_levels,
+                )
+        else:
+            server = ThreadedServer(config, evaluate, eval_batches, params,
+                                    metrics_path=metrics_path)
+
+            def make_worker(worker_id, shard):
+                return ThreadedWorker(
+                    worker_id, server.worker_data_queue,
+                    server.result_queues[worker_id], local_train, shard,
+                    config.round, config.seed,
+                )
 
     pool = NativeThreadPool(config.worker_number)
     try:

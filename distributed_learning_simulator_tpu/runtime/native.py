@@ -189,6 +189,13 @@ class NativeTaskQueue:
         if rc != 0:
             raise RuntimeError("queue is stopped")
 
+    @property
+    def stopped(self) -> bool:
+        """True once stop() was called (or the C++ queue was stopped via
+        this wrapper); lets callers distinguish the benign stopped-queue
+        race from a genuine enqueue failure."""
+        return self._stopped
+
     def stop(self) -> None:
         if not self._stopped:
             self._stopped = True

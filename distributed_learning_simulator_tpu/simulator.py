@@ -68,26 +68,39 @@ from distributed_learning_simulator_tpu.utils.tracing import (
 )
 
 
-def _auto_chunk_size(config, global_params, n_clients: int) -> int:
-    """In-flight clients from the footprint model shared with the OOM
-    diagnostics (the ONE copy of the model — _oom_hint derives its
-    suggestion from this function): ~4x the f32 param bytes of transient
-    state per in-flight client (grads + momentum + conv weight-grad temps
-    incl. fragmentation) against 60% of per-device HBM times the mesh
-    size, minus any PERSISTENT per-client state that is resident
-    regardless of chunking (momentum-sign_SGD buffers, non-reset client
-    optimizer state). Validated on v5e: suggests ~57 for ResNet-18 x 1000
-    clients, inside the measured-safe 40-100 range."""
-    param_bytes = sum(
+def _f32_param_bytes(global_params) -> int:
+    """f32 bytes of one model's params (works on arrays or ShapeDtypeStructs)."""
+    return sum(
         leaf.size * 4 for leaf in jax.tree_util.tree_leaves(global_params)
     )
+
+
+def _device_budget_bytes(config) -> float:
+    """Usable device memory for per-client state: 60% of per-device HBM
+    times the mesh size (the client axis is split across mesh devices);
+    16 GB fallback when the plugin doesn't report memory stats. The ONE
+    copy of the budget model shared by the chunk auto-sizer, the OOM hint,
+    and the materializing-path feasibility refusal."""
     hbm = 16 * 1024**3
     try:
         stats = jax.devices()[0].memory_stats()
         hbm = stats.get("bytes_limit", hbm) or hbm
     except Exception:
         pass
-    n_mesh = config.mesh_devices or 1
+    return 0.6 * hbm * (config.mesh_devices or 1)
+
+
+def _auto_chunk_size(config, global_params, n_clients: int) -> int:
+    """In-flight clients from the footprint model shared with the OOM
+    diagnostics (_oom_hint derives its suggestion from this function):
+    ~4x the f32 param bytes of transient state per in-flight client
+    (grads + momentum + conv weight-grad temps incl. fragmentation)
+    against the _device_budget_bytes budget, minus any PERSISTENT
+    per-client state that is resident regardless of chunking
+    (momentum-sign_SGD buffers, non-reset client optimizer state).
+    Validated on v5e: suggests ~57 for ResNet-18 x 1000 clients, inside
+    the measured-safe 40-100 range."""
+    param_bytes = _f32_param_bytes(global_params)
     # Persistent (chunk-independent) per-client state: one param-sized
     # buffer per client for momentum sign_SGD or a persistent sgd
     # optimizer, two for persistent adam.
@@ -101,9 +114,35 @@ def _auto_chunk_size(config, global_params, n_clients: int) -> int:
         persistent_factor = (
             2 if config.optimizer_name.lower() in ("adam", "adamw") else 1
         )
-    budget = 0.6 * hbm * n_mesh - persistent_factor * n_clients * param_bytes
+    budget = (
+        _device_budget_bytes(config)
+        - persistent_factor * n_clients * param_bytes
+    )
     estimate = max(1, int(budget / (4 * param_bytes)))
     return min(estimate, config.cohort_size(n_clients))
+
+
+def _assert_client_stack_feasible(config, global_params, n_clients: int):
+    """Refuse the materializing path clearly when it cannot fit.
+
+    Algorithms with ``keep_client_params`` (Shapley scoring, forced
+    client_eval) hold the FULL ``[n_clients, params]`` f32 stack resident —
+    chunking bounds the training transients, not this stack. At large N x
+    large model that dies as a generic device OOM deep inside dispatch;
+    mirror MultiRoundShapley's explicit N>16 refusal with a sized error
+    instead (same footprint/budget model as _auto_chunk_size)."""
+    param_bytes = _f32_param_bytes(global_params)
+    stack_bytes = n_clients * param_bytes
+    budget = _device_budget_bytes(config)
+    if stack_bytes > budget:
+        raise ValueError(
+            f"{config.distributed_algorithm!r} materializes the per-client "
+            f"parameter stack: {n_clients} clients x "
+            f"{param_bytes / 2**20:.0f} MB = {stack_bytes / 2**30:.1f} GB, "
+            f"over the ~{budget / 2**30:.1f} GB device budget "
+            f"({config.mesh_devices or 1} device(s)). Use fewer clients, a "
+            "smaller model, or more mesh_devices."
+        )
 
 
 @contextmanager
@@ -135,9 +174,7 @@ def _oom_hint(config, global_params, n_clients: int, site: str = "round"):
             f"(currently {config.eval_batch_size})."
             if site != "round" else ""
         )
-        param_bytes = sum(
-            leaf.size * 4 for leaf in jax.tree_util.tree_leaves(global_params)
-        )
+        param_bytes = _f32_param_bytes(global_params)
         estimate = _auto_chunk_size(config, global_params, n_clients)
         suggestion = min(estimate, max(1, current // 2))
         if suggestion >= current:
@@ -301,6 +338,8 @@ def run_simulation(
         momentum=config.momentum, weight_decay=config.weight_decay,
     )
     algorithm = get_algorithm(config.distributed_algorithm, config)
+    if algorithm.keep_client_params:
+        _assert_client_stack_feasible(config, global_params, n_clients)
 
     evaluate = jax.jit(make_eval_fn(model.apply, preprocess=eval_preprocess))
     algorithm.prepare(
@@ -346,6 +385,7 @@ def run_simulation(
     if config.resume and config.checkpoint_dir:
         ckpt_path = latest_checkpoint(config.checkpoint_dir)
         if ckpt_path:
+            resumed_basename = os.path.basename(ckpt_path)
             ckpt = load_checkpoint(ckpt_path)
             global_params = jax.tree_util.tree_map(
                 jnp.asarray, ckpt["global_params"]
@@ -411,6 +451,34 @@ def run_simulation(
                     ckpt["algo_state"].get("shapley_values", {})
                 )
             logger.info("resumed from %s at round %d", ckpt_path, start_round)
+        else:
+            resumed_basename = ""
+        if config.multihost and jax.process_count() > 1:
+            # Checkpoints are written by process 0 only, but every process
+            # restores independently from its own view of checkpoint_dir.
+            # Without a shared filesystem the processes can restore
+            # different rounds (or some none at all) and then dispatch
+            # DIFFERENT numbers of SPMD round programs — a collective
+            # mismatch (hang) or a silent split. Verify agreement before
+            # any sharded dispatch; checkpoint_dir must be on storage all
+            # hosts see (NFS/GCS-fuse) for multihost resume.
+            import zlib
+
+            from jax.experimental import multihost_utils
+
+            local = np.asarray(
+                [start_round, zlib.crc32(resumed_basename.encode())],
+                dtype=np.int64,
+            )
+            gathered = multihost_utils.process_allgather(local)
+            if not (gathered == gathered[0]).all():
+                raise RuntimeError(
+                    "multihost resume mismatch: processes restored "
+                    "different checkpoints (per-process [start_round, "
+                    f"path_crc32] = {gathered.tolist()}); checkpoint_dir "
+                    "must be a shared filesystem visible to every host "
+                    "with an identical checkpoint set"
+                )
 
     # --- placement ----------------------------------------------------------
     mesh = None
@@ -462,6 +530,16 @@ def run_simulation(
             and (client_state is not None or server_state is not None)
         )
     )
+    if config.pipeline_rounds and not pipelined:
+        # The user asked for pipelining; say out loud why it is off (each
+        # deferred fetch otherwise silently costs a full host-link RTT).
+        reason = (
+            "the algorithm's post_round must see each round's metrics"
+            if not algorithm.supports_round_pipelining
+            else "checkpointing needs per-client/server-optimizer state "
+            "that round r+1's dispatch would donate away"
+        )
+        logger.info("pipeline_rounds disabled: %s", reason)
     t_start = time.perf_counter()
     t_prev_done = t_start
     pending: dict | None = None

@@ -1,0 +1,87 @@
+"""Experiment: per-leaf (padded) vs flat-vector SGD update + SR store.
+
+The flagship round profile shows the per-client momentum-SGD update +
+hash-SR bf16 store fusions running at ~280 GB/s on 64-channel param leaves
+([C,3,3,64,64]: the (8,128) tiling pads lanes 64->128) vs ~700 GB/s on
+512-channel leaves. A single flat [C, P] parameter vector has no lane
+padding. This measures both formulations of one update step at flagship
+scale (C=40 clients x ResNet-18).
+
+Usage: python scripts/exp_flat_update.py [n_chain]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.models.registry import (
+    get_model,
+    init_params,
+)
+from distributed_learning_simulator_tpu.parallel.engine import _sr_tree_to_bf16
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    C = 40
+    model = get_model("resnet18", num_classes=10)
+    p0 = init_params(model, np.zeros((1, 32, 32, 3), np.float32), seed=0)
+
+    def stack(tree, fill):
+        return jax.tree_util.tree_map(
+            lambda l: jnp.full((C,) + l.shape, fill, jnp.bfloat16), tree
+        )
+
+    ptree, mtree, gtree = stack(p0, 1.0), stack(p0, 0.0), stack(p0, 0.01)
+    flat = lambda t: jnp.concatenate(  # noqa: E731
+        [jnp.reshape(l, (C, -1)) for l in jax.tree_util.tree_leaves(t)], axis=1
+    )
+    pflat, mflat, gflat = flat(ptree), flat(mtree), flat(gtree)
+    print("flat shape", pflat.shape)
+
+    def upd_tree(p, m, g, salt):
+        m2 = jax.tree_util.tree_map(
+            lambda mm, gg: 0.9 * mm.astype(jnp.float32)
+            + gg.astype(jnp.float32),
+            m, g,
+        )
+        summed = jax.tree_util.tree_map(
+            lambda pp, mm: pp.astype(jnp.float32) - 0.1 * mm, p, m2
+        )
+        p2, salt = _sr_tree_to_bf16(summed, salt)
+        m2 = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), m2)
+        return p2, m2, salt
+
+    def upd_flat(p, m, g, salt):
+        m2 = 0.9 * m.astype(jnp.float32) + g.astype(jnp.float32)
+        summed = p.astype(jnp.float32) - 0.1 * m2
+        p2, salt = _sr_tree_to_bf16(summed, salt)
+        return p2, m2.astype(jnp.bfloat16), salt
+
+    def chain(fn, p, m, g):
+        out = fn(p, m, g, jnp.uint32(1))
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        o = out
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = fn(o[0], o[1], g, o[2])
+        jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[:1])
+        return (time.perf_counter() - t0) / n
+
+    f_tree = jax.jit(upd_tree, donate_argnums=(0, 1))
+    f_flat = jax.jit(upd_flat, donate_argnums=(0, 1))
+    t_tree = chain(f_tree, ptree, mtree, gtree)
+    t_flat = chain(f_flat, pflat, mflat, gflat)
+    print(f"tree update+SR: {t_tree*1e3:6.2f} ms/step-chunk")
+    print(f"flat update+SR: {t_flat*1e3:6.2f} ms/step-chunk")
+
+
+if __name__ == "__main__":
+    main()

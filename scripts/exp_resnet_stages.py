@@ -1,0 +1,99 @@
+"""Experiment: where the flagship per-client ResNet fwd+bwd time lives.
+
+Builds truncated ResNet-18 variants (stem only, +stage1, +stage2, ...) and
+times one vmapped per-client fwd+bwd step (chunk clients x batch) for each;
+successive differences attribute time to stages. Cross-checks the
+single-layer microbench (exp_client_conv.py) against in-context cost.
+
+Usage: python scripts/exp_resnet_stages.py [n_chain] [chunk] [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_learning_simulator_tpu.models.resnet import ResidualBlock
+
+
+class TruncatedResNet(nn.Module):
+    n_stages: int
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=32, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for stage in range(self.n_stages):
+            features = self.width * (2 ** stage)
+            for block in range(2):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResidualBlock(features, strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(10, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def timeit(fn, args, n):
+    out = fn(*args)
+    jax.device_get(out)
+    t0 = time.perf_counter()
+    acc = out
+    for _ in range(n):
+        acc = acc + fn(*args)
+    jax.device_get(acc)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    n_chain = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (chunk, batch, 32, 32, 3), jnp.float32)
+    y = jax.random.randint(jax.random.fold_in(key, 1), (chunk, batch), 0, 10)
+
+    prev = 0.0
+    for n_stages in range(5):
+        model = TruncatedResNet(n_stages=n_stages)
+        params = model.init(jax.random.fold_in(key, 2), x[0])["params"]
+        # One weight set per client.
+        cparams = jax.vmap(lambda i: jax.tree_util.tree_map(
+            lambda p: p + 0.0 * i, params))(jnp.arange(chunk, dtype=jnp.float32))
+
+        def loss(p, xc, yc):
+            logits = model.apply({"params": p}, xc)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, yc[:, None], axis=1)
+            )
+
+        def step(cp, x_, y_):
+            l, gr = jax.vmap(jax.value_and_grad(loss))(cp, x_, y_)
+            return jnp.sum(l) + sum(
+                jnp.sum(g.astype(jnp.float32))
+                for g in jax.tree_util.tree_leaves(gr)
+            )
+
+        t = timeit(jax.jit(step), (cparams, x, y), n_chain)
+        print(
+            f"stem+{n_stages} stages: {t*1e3:7.2f} ms/step "
+            f"(delta {1e3*(t-prev):+7.2f} ms)"
+        )
+        prev = t
+
+
+if __name__ == "__main__":
+    main()

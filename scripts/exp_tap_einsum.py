@@ -1,0 +1,126 @@
+"""Experiment: 9-tap shifted-einsum per-client conv vs vmapped lax.conv.
+
+The vmapped per-client conv's backward lowers the client axis into a
+base-dilated spatial dim (lhs_dilate=1x1xC) — XLA's generic slow path.
+A 3x3 conv is also 9 shifted batched GEMMs: for tap (dy, dx),
+``y += shift(x, dy, dx) @ w[dy, dx]`` with einsum 'cbhwk,cko->cbhwo'.
+Autodiff then yields pure batched-GEMM gradients (no conv lowering at all).
+
+Usage: python scripts/exp_tap_einsum.py [n_chain] [chunk] [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+STAGES = [
+    ("stage1", 32, 64, 64),
+    ("stage2", 16, 128, 128),
+    ("stage3", 8, 256, 256),
+    ("stage4", 4, 512, 512),
+]
+
+
+def timeit(fn, args, n):
+    out = fn(*args)
+    jax.device_get(out)
+    t0 = time.perf_counter()
+    acc = out
+    for _ in range(n):
+        acc = acc + fn(*args)
+    jax.device_get(acc)
+    return (time.perf_counter() - t0) / n
+
+
+def tap_conv(x, w):
+    """Per-client 3x3 SAME conv as 9 shifted batched GEMMs.
+
+    x: [C, B, H, W, cin], w: [C, 3, 3, cin, cout] -> [C, B, H, W, cout].
+    """
+    c, b, h, wd, cin = x.shape
+    cout = w.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1), (0, 0)))
+    y = jnp.zeros((c, b, h, wd, cout), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            xs = jax.lax.dynamic_slice(
+                xp, (0, 0, dy, dx, 0), (c, b, h, wd, cin)
+            )
+            y = y + jnp.einsum(
+                "cbhwk,cko->cbhwo", xs, w[:, dy, dx],
+                preferred_element_type=jnp.float32,
+            )
+    return y.astype(jnp.bfloat16)
+
+
+def main():
+    n_chain = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+
+    key = jax.random.key(0)
+    for name, hw, cin, cout in STAGES:
+        kx, kw, kg = jax.random.split(jax.random.fold_in(key, hw), 3)
+        x = jax.random.normal(kx, (chunk, batch, hw, hw, cin), jnp.bfloat16)
+        w = jax.random.normal(kw, (chunk, 3, 3, cin, cout), jnp.bfloat16)
+        g = jax.random.normal(kg, (chunk, batch, hw, hw, cout), jnp.bfloat16)
+
+        # A: vmapped conv (baseline)
+        def conv_one(xc, wc):
+            return jax.lax.conv_general_dilated(
+                xc, wc, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+
+        def loss_a(w_, x_):
+            y = jax.vmap(conv_one)(x_, w_)
+            return jnp.sum((y * g).astype(jnp.float32))
+
+        def run_a(w_, x_):
+            gw, gx = jax.grad(loss_a, argnums=(0, 1))(w_, x_)
+            return jnp.sum(gw.astype(jnp.float32)) + jnp.sum(
+                gx.astype(jnp.float32)
+            )
+
+        t_a = timeit(jax.jit(run_a), (w, x), n_chain)
+
+        # D: tap-einsum
+        def loss_d(w_, x_):
+            y = tap_conv(x_, w_)
+            return jnp.sum((y * g).astype(jnp.float32))
+
+        def run_d(w_, x_):
+            gw, gx = jax.grad(loss_d, argnums=(0, 1))(w_, x_)
+            return jnp.sum(gw.astype(jnp.float32)) + jnp.sum(
+                gx.astype(jnp.float32)
+            )
+
+        t_d = timeit(jax.jit(run_d), (w, x), n_chain)
+
+        # Forward-only comparison too (fwd matters for eval + fwd pass).
+        t_af = timeit(
+            jax.jit(lambda w_, x_: jnp.sum(
+                jax.vmap(conv_one)(x_, w_).astype(jnp.float32))),
+            (w, x), n_chain,
+        )
+        t_df = timeit(
+            jax.jit(lambda w_, x_: jnp.sum(
+                tap_conv(x_, w_).astype(jnp.float32))),
+            (w, x), n_chain,
+        )
+        print(
+            f"{name}: fwd+bwd vmap-conv {t_a*1e3:7.2f} ms, tap-einsum "
+            f"{t_d*1e3:7.2f} ms | fwd-only conv {t_af*1e3:6.2f} ms, "
+            f"tap {t_df*1e3:6.2f} ms"
+        )
+
+
+if __name__ == "__main__":
+    main()

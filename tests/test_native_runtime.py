@@ -176,7 +176,9 @@ def test_threaded_rejects_other_algorithms(tiny_config):
         run_threaded_simulation,
     )
 
-    cfg = dataclasses.replace(tiny_config, distributed_algorithm="fed_quant")
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="GTG_shapley_value"
+    )
     with pytest.raises(ValueError, match="threaded"):
         run_threaded_simulation(cfg)
 
@@ -190,6 +192,31 @@ def test_threaded_rejects_bf16_local_state(tiny_config):
 
     cfg = dataclasses.replace(tiny_config, local_compute_dtype="bfloat16")
     with pytest.raises(ValueError, match="local_compute_dtype"):
+        run_threaded_simulation(cfg)
+
+
+def test_threaded_rejects_client_eval(tiny_config):
+    """client_eval telemetry is produced by the vmap path's stacked params;
+    threaded mode must reject rather than silently drop it."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, client_eval=True)
+    with pytest.raises(ValueError, match="client_eval"):
+        run_threaded_simulation(cfg)
+
+
+def test_threaded_rejects_multihost_directly(tiny_config):
+    """The multihost rejection must live in run_threaded_simulation itself
+    (a documented programmatic entry point), not only in run_simulation's
+    dispatch — else each process silently runs a full independent sim."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, multihost=True)
+    with pytest.raises(ValueError, match="multihost"):
         run_threaded_simulation(cfg)
 
 
@@ -273,6 +300,48 @@ def test_threaded_fed_matches_vmap(tiny_config):
     a_t = threaded["history"][-1]["test_accuracy"]
     a_v = vmapped["history"][-1]["test_accuracy"]
     assert abs(a_t - a_v) < 0.15, (a_t, a_v)
+
+
+def test_threaded_fed_quant_learns(tiny_config):
+    """fed_quant through the queue architecture: QAT local training, a
+    genuinely quantized uplink payload, dequantize-aggregate-requantize at
+    the server (reference servers/fed_quant_server.py:25-50)."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="fed_quant", round=3
+    )
+    res = run_threaded_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 3
+    assert res["history"][-1]["test_accuracy"] > 0.4
+    # 8-bit exchange: ~4x smaller than f32 params.
+    assert res["history"][-1]["uplink_compression_ratio"] > 3.0
+
+
+def test_threaded_fed_quant_matches_vmap(tiny_config):
+    """Differential oracle for the quantized exchange path: thread-per-
+    client (quantized uplink decoded server-side) vs the fused vmap
+    quantize->dequantize round program must agree statistically."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="fed_quant", round=4,
+        client_eval=False,
+    )
+    threaded = run_threaded_simulation(cfg, setup_logging=False)
+    vmapped = run_simulation(cfg, setup_logging=False)
+    a_t = threaded["history"][-1]["test_accuracy"]
+    a_v = vmapped["history"][-1]["test_accuracy"]
+    assert abs(a_t - a_v) < 0.15, (a_t, a_v)
+    # Same analytic compression telemetry on both paths.
+    r_t = threaded["history"][-1]["uplink_compression_ratio"]
+    r_v = vmapped["history"][-1]["uplink_compression_ratio"]
+    assert abs(r_t - r_v) < 1e-6, (r_t, r_v)
 
 
 def test_threaded_sign_sgd_many_steps_no_deadlock(tiny_config):

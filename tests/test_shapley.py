@@ -72,6 +72,31 @@ def test_exact_refuses_large_n(tiny_config):
         algo.post_round(ctx)
 
 
+def test_materializing_stack_feasibility_guard(tiny_config):
+    """keep_client_params algorithms must refuse with a sized error when
+    the [n_clients, params] stack cannot fit (mirrors the exact-Shapley
+    N>16 refusal), instead of a generic device OOM deep in dispatch."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.simulator import (
+        _assert_client_stack_feasible,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="GTG_shapley_value"
+    )
+    # ~104 MB of params x 1000 clients = ~104 GB >> any device budget.
+    big_params = {"w": jax.ShapeDtypeStruct((26_000_000,), jnp.float32)}
+    with pytest.raises(ValueError, match="parameter stack"):
+        _assert_client_stack_feasible(cfg, big_params, 1000)
+    # The tiny real config passes untouched.
+    small = {"w": jnp.zeros((100,), jnp.float32)}
+    _assert_client_stack_feasible(cfg, small, 4)
+
+
 def test_gtg_convergence_is_distance_to_final(tiny_config):
     """Reference formula (GTG_shapley_value_server.py:82-91): each of the
     last_k running means is compared to the FINAL running mean, not to its
