@@ -127,7 +127,7 @@ class FedAvg(Algorithm):
             augment=get_augment(cfg.augment),
             compute_dtype=compute_dtype,
         )
-        vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))
+        vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0, None))
         keep = self.keep_client_params
         aggregation = cfg.aggregation.lower()
         # Robust rules need every client's params at once (a median has no
@@ -137,11 +137,11 @@ class FedAvg(Algorithm):
         frac = cfg.participation_fraction
         n_participants = cfg.cohort_size(n_clients)
 
-        def train_clients(global_params, state, x, y, m, keys):
+        def train_clients(global_params, state, x, y, m, keys, lr_scale):
             """Materializing path: returns every client's params stacked
             (needed by Shapley, which re-averages arbitrary subsets)."""
             if chunk is None or chunk >= keys.shape[0]:
-                return vtrain(global_params, state, x, y, m, keys)
+                return vtrain(global_params, state, x, y, m, keys, lr_scale)
 
             # Sequential-over-chunks, vmap-within-chunk (lax.map's batch_size
             # does exactly this): bounds HBM use (per-client param/grad/
@@ -149,14 +149,14 @@ class FedAvg(Algorithm):
             # whole round one XLA program.
             def one_client(args):
                 s, xi, yi, mi, k = args
-                return local_train(global_params, s, xi, yi, mi, k)
+                return local_train(global_params, s, xi, yi, mi, k, lr_scale)
 
             return jax.lax.map(
                 one_client, (state, x, y, m, keys), batch_size=chunk
             )
 
         def train_and_reduce(global_params, state, x, y, m, keys, norm_w,
-                             payload_key):
+                             payload_key, lr_scale):
             """Fused path: per-chunk weighted partial sums accumulate into
             the aggregate directly, so the full [n_clients, n_params] stack
             never materializes — at 1000 clients x ResNet-18 that stack
@@ -180,7 +180,9 @@ class FedAvg(Algorithm):
                 )
 
             if chunk is None or chunk >= k:
-                cp, ns, tm = train_clients(global_params, state, x, y, m, keys)
+                cp, ns, tm = train_clients(
+                    global_params, state, x, y, m, keys, lr_scale
+                )
                 return reduce_chunk(cp, norm_w, payload_key), ns, tm
 
             # chunked_accumulate handles the reshape/scan/remainder
@@ -191,7 +193,7 @@ class FedAvg(Algorithm):
             def compute(chunk_trees, pk):
                 state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
                 cp, ns, tm = vtrain(global_params, state_c, x_c, y_c, m_c,
-                                    keys_c)
+                                    keys_c, lr_scale)
                 return reduce_chunk(cp, w_c, pk), (ns, tm)
 
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, global_params)
@@ -201,7 +203,8 @@ class FedAvg(Algorithm):
             )
             return agg, ns, tm
 
-        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
+        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
+                     lr_scale=1.0):
             part_key, train_key, payload_key, agg_key = jax.random.split(key, 4)
             client_keys = jax.random.split(train_key, n_participants)
             idx = None
@@ -225,7 +228,8 @@ class FedAvg(Algorithm):
             aux = {}
             if materialize:
                 client_params, new_state_k, train_metrics = train_clients(
-                    global_params, state_k, x_k, y_k, m_k, client_keys
+                    global_params, state_k, x_k, y_k, m_k, client_keys,
+                    lr_scale,
                 )
                 if compute_dtype is not None:
                     # Robust rules / Shapley consume the full stack; restore
@@ -263,7 +267,7 @@ class FedAvg(Algorithm):
             else:
                 new_global, new_state_k, train_metrics = train_and_reduce(
                     global_params, state_k, x_k, y_k, m_k, client_keys,
-                    norm_w, payload_key,
+                    norm_w, payload_key, lr_scale,
                 )
                 payload_aux = {}
             # Empty effective cohort (all sampled clients have zero samples,

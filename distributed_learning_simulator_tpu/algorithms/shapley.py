@@ -71,6 +71,42 @@ def shapley_from_utilities(utilities: dict[frozenset, float], n: int) -> np.ndar
     return sv
 
 
+def cap_eval_batches(eval_batches, max_samples: int | None):
+    """First ``max_samples`` test samples as one padded batch (mask-exact).
+
+    Subset-utility evaluations only — the round's reported metric always
+    sees the full set. The flatten+slice happens once per round on device;
+    the evaluator's jitted program then runs on the smaller static shape.
+    """
+    if max_samples is None:
+        return eval_batches
+    xb, yb, mb = eval_batches
+    bs = xb.shape[1]
+    total = xb.shape[0] * bs
+    k = min(max_samples, total)
+    flat = lambda a: a.reshape((total,) + a.shape[2:])  # noqa: E731
+    if k < bs:
+        # One smaller batch: strictly below the eval_batch_size activation
+        # envelope, and masked-out samples cost no compute (the cap's whole
+        # point — padding to bs would run the full batch masked).
+        return (flat(xb)[:k][None], flat(yb)[:k][None], flat(mb)[:k][None])
+    # k spans batches: keep the eval_batch_size scan granularity (the
+    # subset evaluator vmaps _EVAL_CHUNK models over each batch, so one
+    # giant [1, k] batch would blow the memory envelope bs exists to
+    # bound); trim the remainder via the mask.
+    n_batches = min((k + bs - 1) // bs, xb.shape[0])
+    take = n_batches * bs
+    reshape = lambda a: a[:take].reshape(  # noqa: E731
+        (n_batches, bs) + a.shape[1:]
+    )
+    keep = jnp.asarray(np.arange(take) < k, mb.dtype)
+    return (
+        reshape(flat(xb)),
+        reshape(flat(yb)),
+        (flat(mb)[:take] * keep).reshape((n_batches, bs) + mb.shape[2:]),
+    )
+
+
 class _SubsetEvaluator:
     """Chunked, memoized evaluation of subset-model test metrics."""
 
@@ -183,7 +219,11 @@ class MultiRoundShapley(FedAvg):
         masks = subset_masks_all(n, include_empty=True)
         utilities_arr = self._evaluator(
             ctx.aux["client_params"], ctx.sizes, masks,
-            ctx.prev_global_params, ctx.eval_batches,
+            ctx.prev_global_params,
+            cap_eval_batches(
+                ctx.eval_batches,
+                getattr(self.config, "shapley_eval_samples", None),
+            ),
         )
         utilities = {
             frozenset(np.flatnonzero(m).tolist()): float(u)
@@ -283,6 +323,10 @@ class GTGShapley(FedAvg):
 
         client_params = ctx.aux["client_params"]
         memo: dict[frozenset, float] = {}
+        eval_batches = cap_eval_batches(
+            ctx.eval_batches,
+            getattr(self.config, "shapley_eval_samples", None),
+        )
 
         def utilities_for(masks_sets: list[frozenset]) -> None:
             # dict.fromkeys: wave batching legitimately requests the same
@@ -298,12 +342,25 @@ class GTGShapley(FedAvg):
                 mask_rows[r, list(s)] = 1.0
             vals = self._evaluator(
                 client_params, ctx.sizes, mask_rows,
-                ctx.prev_global_params, ctx.eval_batches,
+                ctx.prev_global_params, eval_batches,
             )
             for s, v in zip(todo, vals):
                 memo[s] = float(v)
 
         utilities_for([frozenset()])  # u(empty) = prev-global metric
+        # eps-truncation reference: "running value close to the full-
+        # aggregation metric" (:51-61). With shapley_eval_samples the
+        # subset utilities come from a SUBSAMPLED estimator whose grand-
+        # coalition value differs from the full-set round metric by
+        # subsample noise >> eps — comparing across estimators would make
+        # truncation fire never (or spuriously). Use the grand-coalition
+        # utility from the SAME estimator as the walked prefixes.
+        if getattr(self.config, "shapley_eval_samples", None) is not None:
+            grand = frozenset(range(n))
+            utilities_for([grand])
+            trunc_ref = memo[grand]
+        else:
+            trunc_ref = metric_now
         records: list[np.ndarray] = []
         n_perms = 0
         converged = False
@@ -339,7 +396,7 @@ class GTGShapley(FedAvg):
                 wave: list[frozenset] = []
                 for p_idx, perm in enumerate(perms):
                     if truncated[p_idx] or (
-                        abs(metric_now - v_prev[p_idx]) < self.eps
+                        abs(trunc_ref - v_prev[p_idx]) < self.eps
                     ):
                         truncated[p_idx] = True
                         continue
@@ -354,7 +411,7 @@ class GTGShapley(FedAvg):
                         continue
                     vp = v_prev[p_idx]
                     for j in range(j0, j1):
-                        if abs(metric_now - vp) >= self.eps:
+                        if abs(trunc_ref - vp) >= self.eps:
                             v_j = memo[frozenset(perm[: j + 1])]
                         else:
                             v_j = vp  # truncated: marginal exactly 0

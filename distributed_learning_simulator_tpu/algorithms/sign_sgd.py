@@ -112,7 +112,12 @@ class SignSGD(Algorithm):
         chunk = cfg.client_chunk_size
         has_momentum = mu != 0.0
 
-        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key):
+        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
+                     lr_scale=1.0):
+            # lr_scale: accepted for round-program signature uniformity;
+            # config.validate() rejects non-constant schedules for sign_SGD
+            # (the lr lives in the vote-apply, torch-parity semantics).
+            del lr_scale
             del sizes  # vote is unweighted, parity with sign_sgd_server.py:16-18
             shard_size = cx.shape[1]
             steps_per_epoch = shard_size // batch_size

@@ -102,12 +102,33 @@ class ExperimentConfig:
     # False disables; True with other algorithms is rejected.
     client_eval: bool | None = None
 
+    # --- learning-rate schedule (FedAvg family) -----------------------------
+    # Client optimizers reset every round, so the schedule sets each ROUND's
+    # effective lr: "constant" | "cosine" (decay to lr_min_factor x lr over
+    # lr_schedule_rounds, default the whole run) | "step" (multiply by
+    # lr_step_gamma every lr_step_size rounds). Exceeds the reference (its
+    # lr is fixed for the whole run, simulator.sh:1); added because
+    # constant-lr runs at flagship scale stall or pass through transient
+    # collapses (docs/PERFORMANCE.md).
+    lr_schedule: str = "constant"
+    lr_schedule_rounds: int | None = None  # horizon; None = config.round
+    lr_min_factor: float = 0.0
+    lr_step_size: int = 30
+    lr_step_gamma: float = 0.1
+
     # --- Shapley (algorithms/shapley.py) ------------------------------------
     round_trunc_threshold: float | None = None
     gtg_eps: float = 1e-3
     gtg_last_k: int = 10
     gtg_converge_criteria: float = 0.05
     gtg_max_permutations: int = 500
+    # Cap on test samples used for SUBSET-utility evaluations (the round's
+    # reported test metric always uses the full set). None = full set (the
+    # reference's behavior). At large N the GTG round is compute-bound on
+    # subset inference (tens of thousands of subset models x the whole test
+    # set per round); Monte-Carlo SV noise dwarfs eval-subsampling noise,
+    # so a few-thousand-sample cap buys a near-linear round-time cut.
+    shapley_eval_samples: int | None = None
 
     # --- execution ----------------------------------------------------------
     # "vmap": the fast path — one jitted round program over the client axis.
@@ -257,6 +278,38 @@ class ExperimentConfig:
                 f"unknown execution_mode {self.execution_mode!r}; known: "
                 "vmap, threaded"
             )
+        if (
+            self.shapley_eval_samples is not None
+            and self.shapley_eval_samples < 1
+        ):
+            raise ValueError("shapley_eval_samples must be >= 1 or None")
+        if self.lr_schedule.lower() not in ("constant", "cosine", "step"):
+            raise ValueError(
+                f"unknown lr_schedule {self.lr_schedule!r}; known: "
+                "constant, cosine, step"
+            )
+        if self.lr_schedule.lower() != "constant":
+            if self.distributed_algorithm == "sign_SGD":
+                # sign_SGD's lr lives in the vote-apply (torch-SGD parity
+                # semantics); a round schedule there is untested territory —
+                # reject rather than silently ignore.
+                raise ValueError(
+                    "lr_schedule is supported for the FedAvg family only, "
+                    "not sign_SGD"
+                )
+            if not 0.0 <= self.lr_min_factor <= 1.0:
+                raise ValueError("lr_min_factor must be in [0, 1]")
+            if (
+                self.lr_schedule_rounds is not None
+                and self.lr_schedule_rounds < 1
+            ):
+                raise ValueError(
+                    "lr_schedule_rounds must be >= 1 or None (= whole run)"
+                )
+            if self.lr_step_size < 1:
+                raise ValueError("lr_step_size must be >= 1")
+            if not 0.0 <= self.lr_step_gamma <= 1.0:
+                raise ValueError("lr_step_gamma must be in [0, 1]")
         server_opt = self.server_optimizer_name.lower()
         if server_opt not in ("none", "", "sgd", "adam"):
             raise ValueError(
@@ -293,7 +346,8 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
                 default=None,
             )
         elif f.name in ("n_train", "n_test", "mesh_devices", "num_processes",
-                        "process_id"):
+                        "process_id", "lr_schedule_rounds",
+                        "shapley_eval_samples"):
             parser.add_argument(arg, type=int, default=None)
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
                         "profile_dir", "client_chunk_size", "max_shard_size",

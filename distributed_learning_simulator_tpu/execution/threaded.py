@@ -543,6 +543,13 @@ def run_threaded_simulation(
             "threaded execution mode does not support local_compute_dtype="
             f"{config.local_compute_dtype!r}; use the vmap execution mode"
         )
+    if config.lr_schedule.lower() != "constant":
+        # The schedule factor is threaded through the vmap round program;
+        # the thread-per-client loop would silently train at constant lr.
+        raise ValueError(
+            "threaded execution mode does not support lr_schedule="
+            f"{config.lr_schedule!r}; use the vmap execution mode"
+        )
     if config.client_eval is True:
         # The per-client pre-aggregation telemetry is produced by the vmap
         # path's stacked client params; silently running without it would

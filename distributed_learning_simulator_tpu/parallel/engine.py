@@ -160,7 +160,7 @@ def make_local_train_fn(
 
     sr_enabled = compute_dtype == jnp.bfloat16
 
-    def local_train(params, opt_state, xs, ys, mask, key):
+    def local_train(params, opt_state, xs, ys, mask, key, lr_scale=1.0):
         sr_state = jnp.uint32(0)
         if sr_enabled:
             # Per-client dither salt from the client's key: independent
@@ -218,6 +218,18 @@ def make_local_train_fn(
                     )
                 (loss, acc), grads = grad_fn(params, bx, by, bm)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
+                # Round-level lr schedule (config.lr_schedule): the per-round
+                # factor multiplies the final update, which is EXACT for
+                # both sgd (lr sits outside the momentum buffer, torch
+                # semantics) and adam (lr sits outside the normalization) —
+                # equivalent to rebuilding the optimizer with lr*factor but
+                # without retracing. f32 math, original dtype preserved.
+                updates = jax.tree_util.tree_map(
+                    lambda u: (
+                        u.astype(jnp.float32) * lr_scale
+                    ).astype(u.dtype),
+                    updates,
+                )
                 if sr_enabled:
                     # f32 update math, stochastically-rounded bf16 storage:
                     # plain bf16 apply_updates swallows updates below the

@@ -122,6 +122,24 @@ def _auto_chunk_size(config, global_params, n_clients: int) -> int:
     return min(estimate, config.cohort_size(n_clients))
 
 
+def _lr_factor(config, round_idx: int) -> float:
+    """Per-round lr multiplier from config.lr_schedule (host-side scalar,
+    passed into the jitted round program — no retrace across rounds)."""
+    s = config.lr_schedule.lower()
+    if s == "constant":
+        return 1.0
+    horizon = config.lr_schedule_rounds or config.round
+    if s == "cosine":
+        import math
+
+        progress = min(round_idx / max(horizon - 1, 1), 1.0)
+        return config.lr_min_factor + (1.0 - config.lr_min_factor) * 0.5 * (
+            1.0 + math.cos(math.pi * progress)
+        )
+    # "step" (validate() guarantees the name set)
+    return config.lr_step_gamma ** (round_idx // config.lr_step_size)
+
+
 def _assert_client_stack_feasible(config, global_params, n_clients: int):
     """Refuse the materializing path clearly when it cannot fit.
 
@@ -580,6 +598,8 @@ def run_simulation(
                 if isinstance(v, (int, float, dict))
             },
         }
+        if config.lr_schedule.lower() != "constant":
+            record["lr_factor"] = _lr_factor(config, p["round_idx"])
         t_prev_done = now
         history.append(record)
         if metrics_path:
@@ -621,9 +641,17 @@ def run_simulation(
                 with annotate(f"fl_round_{round_idx}"), _oom_hint(
                     config, global_params, n_clients
                 ):
+                    # The schedule factor is a traced operand only when a
+                    # schedule is active; the constant default uses the
+                    # round_fn's Python default 1.0, which constant-folds
+                    # at trace time (no per-step scale multiply in the
+                    # compiled program).
+                    lr_args = () if config.lr_schedule.lower() == (
+                        "constant"
+                    ) else (jnp.float32(_lr_factor(config, round_idx)),)
                     new_global, client_state, aux = round_jit(
                         global_params, client_state, cx, cy, cmask, sizes,
-                        round_key,
+                        round_key, *lr_args,
                     )
                     if server_update_jit is not None:
                         new_global, server_state = server_update_jit(

@@ -1,0 +1,116 @@
+"""Round-level lr schedules (config.lr_schedule; exceeds the reference,
+whose lr is fixed for the whole run — simulator.sh:1).
+
+The factor multiplies the final optax update inside the jitted round
+program, which is exactly equivalent to rebuilding the optimizer with
+lr * factor (lr sits outside the sgd momentum buffer and outside adam's
+normalization) — so a schedule that stays at factor 1.0 must be
+bit-identical to the constant run, and a factor-0 tail must freeze the
+model.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.simulator import (
+    _lr_factor,
+    run_simulation,
+)
+
+
+def test_lr_factor_values():
+    cfg = ExperimentConfig(
+        lr_schedule="cosine", round=11, lr_min_factor=0.1
+    )
+    assert _lr_factor(cfg, 0) == pytest.approx(1.0)
+    assert _lr_factor(cfg, 10) == pytest.approx(0.1)
+    assert _lr_factor(cfg, 5) == pytest.approx(0.55)  # midpoint
+    # Horizon override + clamp past the horizon.
+    cfg2 = dataclasses.replace(cfg, lr_schedule_rounds=6)
+    assert _lr_factor(cfg2, 5) == pytest.approx(0.1)
+    assert _lr_factor(cfg2, 9) == pytest.approx(0.1)
+    step = ExperimentConfig(
+        lr_schedule="step", lr_step_size=3, lr_step_gamma=0.5
+    )
+    assert [_lr_factor(step, r) for r in (0, 2, 3, 6)] == [
+        1.0, 1.0, 0.5, 0.25,
+    ]
+
+
+def test_unit_factor_schedule_is_bit_identical(tiny_config):
+    """step with step_size > rounds keeps factor 1.0 throughout — must be
+    bit-identical to the constant-schedule run (the scale multiply is the
+    only code-path difference)."""
+    base = run_simulation(tiny_config, setup_logging=False)
+    cfg = dataclasses.replace(
+        tiny_config, lr_schedule="step", lr_step_size=100
+    )
+    sched = run_simulation(cfg, setup_logging=False)
+    for a, b in zip(base["history"], sched["history"]):
+        assert a["test_accuracy"] == b["test_accuracy"]
+        assert a["test_loss"] == b["test_loss"]
+    assert sched["history"][-1]["lr_factor"] == 1.0
+
+
+def test_zero_factor_tail_freezes_model(tiny_config):
+    """step with gamma=0 after round lr_step_size: later rounds train with
+    lr 0, so the global model — and the test metrics — stop moving."""
+    cfg = dataclasses.replace(
+        tiny_config, round=5, lr_schedule="step", lr_step_size=2,
+        lr_step_gamma=0.0,
+    )
+    res = run_simulation(cfg, setup_logging=False)
+    accs = [h["test_accuracy"] for h in res["history"]]
+    losses = [h["test_loss"] for h in res["history"]]
+    # Rounds 2..4 run at factor 0 -> metrics frozen at the round-1 value.
+    assert accs[2] == accs[3] == accs[4]
+    assert losses[2] == losses[3] == losses[4]
+    # And the schedule actually trained before the freeze.
+    assert losses[1] < losses[0] + 1e-9
+    assert res["history"][0]["lr_factor"] == 1.0
+    assert res["history"][4]["lr_factor"] == 0.0
+
+
+def test_cosine_schedule_learns(tiny_config):
+    cfg = dataclasses.replace(
+        tiny_config, round=6, lr_schedule="cosine", lr_min_factor=0.05
+    )
+    res = run_simulation(cfg, setup_logging=False)
+    accs = [h["test_accuracy"] for h in res["history"]]
+    assert accs[-1] > accs[0]
+    factors = [h["lr_factor"] for h in res["history"]]
+    assert factors[0] == pytest.approx(1.0)
+    assert factors[-1] == pytest.approx(0.05)
+    assert all(a >= b for a, b in zip(factors, factors[1:]))  # monotone
+
+
+def test_schedule_rejections(tiny_config):
+    with pytest.raises(ValueError, match="lr_schedule"):
+        dataclasses.replace(tiny_config, lr_schedule="poly").validate()
+    with pytest.raises(ValueError, match="sign_SGD"):
+        dataclasses.replace(
+            tiny_config, distributed_algorithm="sign_SGD",
+            lr_schedule="cosine",
+        ).validate()
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        run_threaded_simulation(
+            dataclasses.replace(tiny_config, lr_schedule="cosine")
+        )
+
+
+def test_schedule_composes_with_bf16_and_chunking(tiny_config):
+    """The scale multiply sits inside the SR store path too."""
+    cfg = dataclasses.replace(
+        tiny_config, round=4, lr_schedule="cosine",
+        local_compute_dtype="bfloat16", client_chunk_size=2,
+    )
+    res = run_simulation(cfg, setup_logging=False)
+    assert np.isfinite(res["history"][-1]["test_loss"])
+    assert res["history"][-1]["lr_factor"] < 1.0

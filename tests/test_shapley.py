@@ -97,6 +97,53 @@ def test_materializing_stack_feasibility_guard(tiny_config):
     _assert_client_stack_feasible(cfg, small, 4)
 
 
+def test_shapley_eval_samples_cap(tiny_config):
+    """shapley_eval_samples evaluates subset utilities on a test subsample
+    (the round metric stays full-set); SVs stay close to the full-set run
+    and the efficiency property holds against the CAPPED utilities."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        cap_eval_batches,
+    )
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    xb = jnp.arange(12.0).reshape(2, 6)
+    yb = jnp.arange(12).reshape(2, 6)
+    mb = jnp.ones((2, 6))
+    # Cap below the batch size: one SMALLER batch (masked padding would
+    # still compute; a smaller batch is strictly under the memory envelope).
+    cxb, cyb, cmb = cap_eval_batches((xb, yb, mb), 4)
+    assert cxb.shape == (1, 4) and cyb.shape == (1, 4)
+    np.testing.assert_allclose(np.asarray(cxb[0]), [0, 1, 2, 3])
+    # None = untouched passthrough (reference behavior): same objects out.
+    passthrough = cap_eval_batches((xb, yb, mb), None)
+    assert passthrough[0] is xb and passthrough[1] is yb
+    # Cap preserves the eval_batch_size scan granularity (memory envelope):
+    # 8 of 12 samples at batch size 6 -> 2 batches of 6, mask-trimmed to 8.
+    x2, y2, m2 = cap_eval_batches((xb, yb, mb), 8)
+    assert x2.shape == (2, 6)
+    np.testing.assert_allclose(np.asarray(m2).sum(), 8)
+
+    base = dataclasses.replace(
+        tiny_config, distributed_algorithm="GTG_shapley_value", round=2,
+        round_trunc_threshold=0.0,
+    )
+    full = run_simulation(base, setup_logging=False)
+    capped = run_simulation(
+        dataclasses.replace(base, shapley_eval_samples=128),
+        setup_logging=False,
+    )
+    sv_f = full["history"][0]["shapley_values"]
+    sv_c = capped["history"][0]["shapley_values"]
+    assert set(sv_c) == set(sv_f)
+    for i in sv_f:
+        assert np.isfinite(sv_c[i])
+        assert abs(sv_c[i] - sv_f[i]) < 0.15, (i, sv_c[i], sv_f[i])
+
+
 def test_gtg_convergence_is_distance_to_final(tiny_config):
     """Reference formula (GTG_shapley_value_server.py:82-91): each of the
     last_k running means is compared to the FINAL running mean, not to its
