@@ -54,6 +54,11 @@ class Algorithm:
     # Shapley algorithms set False — their post_round drives data-dependent
     # subset evaluation that must see the round's metrics synchronously.
     supports_round_pipelining: bool = True
+    # Whether round_fn accepts the optional trailing ``lr_scale`` operand
+    # (config.lr_schedule): the simulator passes it only when a schedule
+    # is active AND the algorithm declares support — an algorithm without
+    # the operand still works with the constant default.
+    supports_lr_schedule: bool = False
 
     def __init__(self, config):
         self.config = config
@@ -64,11 +69,13 @@ class Algorithm:
         preprocess: Callable | None = None,
     ) -> Callable:
         """Return ``round_fn(global_params, client_state, cx, cy, cmask,
-        sizes, key) -> (new_global, new_client_state, aux)``.
+        sizes, key[, lr_scale]) -> (new_global, new_client_state, aux)``.
 
         ``client_state`` is whatever per-client state persists across rounds
         (optimizer/momentum buffers) as a client-stacked pytree; ``aux`` is a
-        dict of diagnostics (device arrays).
+        dict of diagnostics (device arrays). ``lr_scale`` (a traced f32
+        scalar, default 1.0) is passed only when ``supports_lr_schedule``
+        is True and a non-constant ``config.lr_schedule`` is active.
         """
         raise NotImplementedError
 

@@ -31,6 +31,7 @@ from distributed_learning_simulator_tpu.parallel.engine import (
 
 class FedAvg(Algorithm):
     name = "fed"
+    supports_lr_schedule = True  # round_fn accepts the lr_scale operand
 
     def __init__(self, config):
         super().__init__(config)

@@ -7,6 +7,22 @@ non-parameter state that (a) breaks the pure client-stacked-params discipline
 under ``vmap`` and (b) is known to degrade under federated averaging of
 per-client statistics; GroupNorm keeps the model a pure function of params.
 Convs run in bfloat16 on the MXU; logits returned float32.
+
+W-folded stage 1 (federated-vmap TPU layout): 64-channel tensors tile
+(8, 128) with the lane dim padded 64 -> 128 — 2x HBM inflation on exactly
+the stage that dominates the per-client-weights round (flagship profile:
+64-ch ops moved 423 GiB at ~278 GB/s vs ~660 GB/s for 128+-ch ops).
+Folding W-pairs into channels — ``[B, H, W, 64] -> [B, H, W/2, 128]``, a
+PURE reshape of the trailing dims — fills the lanes. A stride-1 3x3 conv
+on the folded form is a 3x3 conv with a packed kernel built from the
+ordinary ``[3, 3, cin, cout]`` parameter by six static slice-assignments
+(:func:`pack_folded_kernel`; 50% fill -> 2x MXU FLOPs, paid from idle MXU
+capacity since the op is bandwidth-bound). The math is exact (the packing
+transpose discards zero-slot gradients), parameters are identical to the
+unfolded model, and GroupNorm statistics are computed on the unfolded
+VIEW (a fused reshape). Measured fwd+bwd per conv at chunk 40 x batch 25:
+88 -> 10.6 ms isolated (scripts/exp_folded_conv.py); whole-round effect in
+docs/PERFORMANCE.md.
 """
 
 from __future__ import annotations
@@ -14,7 +30,202 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+def pack_folded_kernel(w):
+    """``[3, 3, cin, cout] -> [3, 3, 2cin, 2cout]`` for the W-folded conv.
+
+    Output fold position ``sx`` and input fold position ``tx``: an original
+    tap ``dx`` at output column ``2J+sx`` reads input column
+    ``2J + (sx+dx-1) = 2(J+V) + tx`` — six (sx, dx) placements, zeros
+    elsewhere. Exact; autodiff's transpose scatters gradients back to the
+    six slots and discards the zero slots.
+    """
+    cin, cout = w.shape[2], w.shape[3]
+    zero = jnp.zeros((3, cin, cout), w.dtype)
+
+    # Trailing-dim block assembly ONLY (concat over the ci/co axes, stack
+    # over the leading tap axis): an .at[].set build lowers to ~20 GB/s
+    # dynamic-update-slice chains, and a stack+6D-transpose materializes
+    # the full packed tensor twice — both measured as real round costs.
+    def tap(v, tx, sx):
+        dx = 2 * v + tx - sx + 1
+        return w[:, dx] if 0 <= dx <= 2 else zero
+
+    vs = []
+    for v in (-1, 0, 1):
+        rows = [
+            jnp.concatenate([tap(v, tx, 0), tap(v, tx, 1)], axis=-1)
+            for tx in range(2)
+        ]
+        vs.append(jnp.concatenate(rows, axis=-2))  # [3(dy), 2cin, 2cout]
+    return jnp.stack(vs, axis=1)  # [3(dy), 3(v), 2cin, 2cout]
+
+
+def pack_folded_stride2_kernel(w):
+    """``[3, 3, cin, cout] -> [3, 2, 2cin, cout]``: stride-2 3x3 conv
+    consuming the folded layout, producing the UNFOLDED downsampled map.
+
+    SAME padding at stride 2 pads (low 0, high 1), so unfolded output
+    column j reads input columns ``2j+dx = 2(j+V)+tx``, V in {0, 1}: a
+    (3, 2)-tap conv on folded cols with strides (2, 1) and explicit
+    padding ((0, 1), (0, 1)). 3 of 4 (V, tx) slots are live.
+    """
+    cin, cout = w.shape[2], w.shape[3]
+    zero = jnp.zeros((3, cin, cout), w.dtype)
+
+    def tap(v, tx):
+        dx = 2 * v + tx
+        return w[:, dx] if 0 <= dx <= 2 else zero
+
+    vs = [
+        jnp.concatenate([tap(v, 0), tap(v, 1)], axis=-2)  # [3, 2cin, cout]
+        for v in (0, 1)
+    ]
+    return jnp.stack(vs, axis=1)  # [3(dy), 2(v), 2cin, cout]
+
+
+def pack_folded_pointwise_stride2(w):
+    """``[1, 1, cin, cout] -> [1, 1, 2cin, cout]``: the 1x1 stride-2
+    projection reads only even columns = the tx=0 half of a folded pixel."""
+    return jnp.concatenate([w, jnp.zeros_like(w)], axis=2)
+
+
+class FoldedConv3x3(nn.Module):
+    """Stride-1 SAME 3x3 conv on the W-folded layout ``[B, H, W/2, 2cin]``.
+
+    The parameter is the ordinary unfolded ``[3, 3, cin, cout]`` kernel
+    (same name/shape/init as ``nn.Conv``); packing happens per forward.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, xf):
+        cin = xf.shape[-1] // 2
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, cin, self.features), jnp.float32,
+        )
+        wp = pack_folded_kernel(kernel.astype(self.dtype))
+        return jax.lax.conv_general_dilated(
+            xf.astype(self.dtype), wp, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+class FoldedGroupNorm(nn.Module):
+    """GroupNorm computed directly ON the folded layout.
+
+    GroupNorm over folded channels naively would pool the two folded
+    columns' channel ranges into wrong groups. Unfolding for an inner
+    ``nn.GroupNorm`` is correct but breaks XLA fusion at the reshape
+    boundary (measured: the stats re-read the activations as separate
+    ~380 GB/s reduces, ~0.5 s/round). Instead: folded channel
+    ``c' = tx*C + g*cpg + i``, so a trailing-dim reshape to
+    ``[.., 2(tx), G, cpg]`` exposes the group axis and the statistics
+    reduce over ``(H, Wf, tx, cpg)`` — same elements as the unfolded
+    norm, never leaving the folded layout. scale/bias are per-channel
+    ``[C]`` (identical to ``nn.GroupNorm``'s params), tiled across tx.
+    """
+
+    num_groups: int
+    dtype: jnp.dtype = jnp.bfloat16
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, xf):
+        b, h, wf, c2 = xf.shape
+        c = c2 // 2
+        g = self.num_groups
+        cpg = c // g
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
+        # One-pass statistics (E[x^2] - E[x]^2, flax's use_fast_variance):
+        # the two-pass (x - mean)^2 form reads the activations twice and
+        # measurably halves this fusion's effective bandwidth.
+        mean = jnp.mean(x, axis=(1, 2, 3, 5), keepdims=True)
+        mean2 = jnp.mean(jnp.square(x), axis=(1, 2, 3, 5), keepdims=True)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+        norm = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        norm = norm.reshape(b, h, wf, c2)
+        return (
+            norm * jnp.tile(scale, 2) + jnp.tile(bias, 2)
+        ).astype(self.dtype)
+
+
+class FoldedResidualBlock(nn.Module):
+    """Stage-1 basic block on the W-folded layout (stride 1, no
+    projection — exactly the shape regime where folding applies)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, xf):
+        residual = xf
+        y = FoldedConv3x3(self.features, dtype=self.dtype)(xf)
+        y = FoldedGroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype
+        )(y)
+        y = nn.relu(y)
+        y = FoldedConv3x3(self.features, dtype=self.dtype)(y)
+        y = FoldedGroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype
+        )(y)
+        return nn.relu(y + residual)
+
+
+class FoldedTransitionBlock(nn.Module):
+    """Stage-2 entry block (stride-2, with projection shortcut) consuming
+    the FOLDED stage-1 output directly: the stride-2 convs read folded
+    (lane-full) inputs and produce the unfolded downsampled map, so the
+    explicit unfold reshape — and the padded stride-2 convs on
+    ``[.., 32, 32, 64]`` it fed — disappear entirely."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, xf):
+        cin = xf.shape[-1] // 2
+        w1 = self.param(
+            "conv1_kernel", nn.initializers.lecun_normal(),
+            (3, 3, cin, self.features), jnp.float32,
+        )
+        y = jax.lax.conv_general_dilated(
+            xf.astype(self.dtype),
+            pack_folded_stride2_kernel(w1.astype(self.dtype)),
+            (2, 1), ((0, 1), (0, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = nn.GroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype
+        )(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.GroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype
+        )(y)
+        wp = self.param(
+            "proj_kernel", nn.initializers.lecun_normal(),
+            (1, 1, cin, self.features), jnp.float32,
+        )
+        residual = jax.lax.conv_general_dilated(
+            xf.astype(self.dtype),
+            pack_folded_pointwise_stride2(wp.astype(self.dtype)),
+            (2, 1), ((0, 0), (0, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        residual = nn.GroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype
+        )(residual)
+        return nn.relu(y + residual)
 
 
 class ResidualBlock(nn.Module):
@@ -53,6 +264,11 @@ class ResNet18(nn.Module):
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # W-folded stage 1 (module docstring): lane-filling layout for the
+    # 64-channel stage. Identical parameters and math; only the compute
+    # layout changes. Applicable when the stage is stride-1 at width 64
+    # with an even spatial W — the CIFAR-style configuration.
+    fold_stage1: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -62,11 +278,37 @@ class ResNet18(nn.Module):
                     dtype=self.dtype)(x)
         x = nn.GroupNorm(num_groups=min(32, self.width), dtype=self.dtype)(x)
         x = nn.relu(x)
+        folded = False
         for stage, n_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**stage)
+            if (
+                stage == 0
+                and self.fold_stage1
+                and features == 64
+                # Even W: the fold pairs columns. Even H: the transition
+                # block's stride-2 row taps assume SAME's (0, 1) padding,
+                # which only matches at even H.
+                and x.shape[1] % 2 == 0
+                and x.shape[2] % 2 == 0
+            ):
+                b, h, w, c = x.shape
+                x = x.reshape(b, h, w // 2, 2 * c)  # pure reshape fold
+                folded = True
+                for block in range(n_blocks):
+                    x = FoldedResidualBlock(features, dtype=self.dtype)(x)
+                continue
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = ResidualBlock(features, strides, dtype=self.dtype)(x)
+                if folded and block == 0:
+                    # Stride-2 entry consumes the folded map directly and
+                    # emits the unfolded downsampled one.
+                    x = FoldedTransitionBlock(features, dtype=self.dtype)(x)
+                    folded = False
+                else:
+                    x = ResidualBlock(features, strides, dtype=self.dtype)(x)
+        if folded:  # single-stage configuration: unfold for the head
+            b, h, wf, c2 = x.shape
+            x = x.reshape(b, h, wf * 2, c2 // 2)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x.astype(jnp.float32)

@@ -358,6 +358,17 @@ def run_simulation(
     algorithm = get_algorithm(config.distributed_algorithm, config)
     if algorithm.keep_client_params:
         _assert_client_stack_feasible(config, global_params, n_clients)
+    if config.lr_schedule.lower() != "constant" and not getattr(
+        algorithm, "supports_lr_schedule", False
+    ):
+        # Capability lives on the Algorithm class, not a config-level name
+        # list: a third-party algorithm whose round_fn lacks the lr_scale
+        # operand must fail HERE with the cause, not with an arity
+        # TypeError at the first round dispatch.
+        raise ValueError(
+            f"algorithm {config.distributed_algorithm!r} does not support "
+            "lr_schedule (its round program takes no lr_scale operand)"
+        )
 
     evaluate = jax.jit(make_eval_fn(model.apply, preprocess=eval_preprocess))
     algorithm.prepare(
@@ -405,6 +416,20 @@ def run_simulation(
         if ckpt_path:
             resumed_basename = os.path.basename(ckpt_path)
             ckpt = load_checkpoint(ckpt_path)
+            want_gp = jax.tree_util.tree_structure(global_params)
+            got_gp = jax.tree_util.tree_structure(ckpt["global_params"])
+            if want_gp != got_gp:
+                # Fail here with the cause, not mid-apply with a missing-
+                # param error: e.g. a checkpoint written before a model's
+                # internal layout change (resnet18 fold_stage1 renames its
+                # block modules) or with a different model_name entirely.
+                raise ValueError(
+                    "checkpoint global_params do not match this model's "
+                    f"parameter structure ({config.model_name!r}); the "
+                    "checkpoint was written with a different model or "
+                    "model version — resume with the configuration it was "
+                    "written with"
+                )
             global_params = jax.tree_util.tree_map(
                 jnp.asarray, ckpt["global_params"]
             )

@@ -1,0 +1,112 @@
+"""W-folded stage 1 of the ResNet (models/resnet.py): exact-math layout
+transform, not an architecture change. The folded model must compute the
+SAME function as the unfolded one given the same parameters."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.models.resnet import (
+    ResNet18,
+    pack_folded_kernel,
+)
+
+
+def test_pack_folded_kernel_exact():
+    """Folded conv == plain conv on the folded/unfolded views (f32)."""
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (2, 8, 8, 4), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (3, 3, 4, 4),
+                          jnp.float32)
+
+    def conv(xx, ww):
+        return jax.lax.conv_general_dilated(
+            xx, ww, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    y_ref = conv(x, w)
+    xf = x.reshape(2, 8, 4, 8)
+    y_fold = conv(xf, pack_folded_kernel(w)).reshape(y_ref.shape)
+    np.testing.assert_allclose(
+        np.asarray(y_fold), np.asarray(y_ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def _transplant(unfolded, folded):
+    """Copy the unfolded model's params into the folded model's tree."""
+    out = jax.tree_util.tree_map(lambda x: x, folded)  # deep-ish copy
+    n_folded = len([k for k in folded if k.startswith("FoldedResidualBlock")])
+    for i in range(n_folded):
+        src = unfolded[f"ResidualBlock_{i}"]
+        dst = out[f"FoldedResidualBlock_{i}"]
+        for j in range(2):
+            dst[f"FoldedConv3x3_{j}"]["kernel"] = src[f"Conv_{j}"]["kernel"]
+            dst[f"FoldedGroupNorm_{j}"]["scale"] = src[f"GroupNorm_{j}"][
+                "scale"
+            ]
+            dst[f"FoldedGroupNorm_{j}"]["bias"] = src[f"GroupNorm_{j}"][
+                "bias"
+            ]
+    # Transition block (stage-2 entry): unfolded ResidualBlock_{n_folded}
+    # with a projection shortcut (Conv_2/GroupNorm_2).
+    trans = unfolded[f"ResidualBlock_{n_folded}"]
+    ftb = out["FoldedTransitionBlock_0"]
+    ftb["conv1_kernel"] = trans["Conv_0"]["kernel"]
+    ftb["Conv_0"]["kernel"] = trans["Conv_1"]["kernel"]
+    ftb["proj_kernel"] = trans["Conv_2"]["kernel"]
+    for j in range(3):
+        ftb[f"GroupNorm_{j}"] = trans[f"GroupNorm_{j}"]
+    n_rest = len([k for k in folded if k.startswith("ResidualBlock")])
+    for k in range(n_rest):
+        out[f"ResidualBlock_{k}"] = unfolded[
+            f"ResidualBlock_{k + n_folded + 1}"
+        ]
+    for shared in ("Conv_0", "GroupNorm_0", "Dense_0"):
+        out[shared] = unfolded[shared]
+    return out
+
+
+def test_folded_resnet_matches_unfolded():
+    """Same params -> same logits (f32 exact up to accumulation order;
+    bf16 within a couple of output ulps)."""
+    x = np.asarray(
+        jax.random.normal(jax.random.key(2), (4, 32, 32, 3), jnp.float32)
+    )
+    for dtype, tol in ((jnp.float32, 1e-4), (jnp.bfloat16, 0.15)):
+        unfolded_model = ResNet18(fold_stage1=False, dtype=dtype)
+        folded_model = ResNet18(fold_stage1=True, dtype=dtype)
+        pu = unfolded_model.init(jax.random.key(0), x[:1])["params"]
+        pf = folded_model.init(jax.random.key(0), x[:1])["params"]
+        pf = _transplant(pu, pf)
+        yu = unfolded_model.apply({"params": pu}, x)
+        yf = folded_model.apply({"params": pf}, x)
+        np.testing.assert_allclose(
+            np.asarray(yf), np.asarray(yu), rtol=tol, atol=tol,
+        ), dtype
+
+
+def test_folded_param_count_unchanged():
+    """Folding changes layout only: identical total parameter count."""
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    pu = ResNet18(fold_stage1=False).init(jax.random.key(0), x)["params"]
+    pf = ResNet18(fold_stage1=True).init(jax.random.key(0), x)["params"]
+    count = lambda t: sum(  # noqa: E731
+        l.size for l in jax.tree_util.tree_leaves(t)
+    )
+    assert count(pu) == count(pf)
+
+
+def test_folded_resnet_trains(tiny_config):
+    """End-to-end: the folded flagship model learns under the engine."""
+    import dataclasses
+
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(
+        tiny_config, model_name="resnet18", worker_number=2, round=2,
+        batch_size=8, n_train=64, n_test=32,
+        dataset_args={"difficulty": 0.5, "shape": (32, 32, 3)},
+    )
+    res = run_simulation(cfg, setup_logging=False)
+    assert np.isfinite(res["history"][-1]["test_loss"])

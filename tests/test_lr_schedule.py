@@ -105,6 +105,30 @@ def test_schedule_rejections(tiny_config):
         )
 
 
+def test_schedule_requires_algorithm_capability(tiny_config, monkeypatch):
+    """The capability lives on the Algorithm class: an algorithm whose
+    round program lacks the lr_scale operand fails with the cause, not an
+    arity TypeError at first dispatch."""
+    from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
+
+    monkeypatch.setattr(FedAvg, "supports_lr_schedule", False)
+    cfg = dataclasses.replace(tiny_config, lr_schedule="cosine")
+    with pytest.raises(ValueError, match="lr_scale operand"):
+        run_simulation(cfg, setup_logging=False)
+
+
+def test_resume_rejects_model_structure_mismatch(tiny_config, tmp_path):
+    """A checkpoint written with a different model (or model layout
+    version) must fail at resume with the cause, not mid-apply."""
+    cfg = dataclasses.replace(
+        tiny_config, checkpoint_dir=str(tmp_path), checkpoint_every=1,
+    )
+    run_simulation(cfg, setup_logging=False)
+    other = dataclasses.replace(cfg, model_name="cnn_tpu", resume=True)
+    with pytest.raises(ValueError, match="parameter structure"):
+        run_simulation(other, setup_logging=False)
+
+
 def test_schedule_composes_with_bf16_and_chunking(tiny_config):
     """The scale multiply sits inside the SR store path too."""
     cfg = dataclasses.replace(
