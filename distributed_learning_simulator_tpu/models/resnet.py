@@ -147,7 +147,9 @@ class FoldedGroupNorm(nn.Module):
         x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
         # One-pass statistics (E[x^2] - E[x]^2, flax's use_fast_variance):
         # the two-pass (x - mean)^2 form reads the activations twice and
-        # measurably halves this fusion's effective bandwidth.
+        # measurably halves this fusion's effective bandwidth. (An
+        # indicator-matrix matmul formulation of the group reduction was
+        # also tried — identical round time, so the simpler form stays.)
         mean = jnp.mean(x, axis=(1, 2, 3, 5), keepdims=True)
         mean2 = jnp.mean(jnp.square(x), axis=(1, 2, 3, 5), keepdims=True)
         var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
