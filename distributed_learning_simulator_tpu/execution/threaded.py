@@ -163,6 +163,14 @@ class ThreadedServer(_QueueServerBase):
         del aggregated
         return {}
 
+    def _post_round(self, stacked, sizes, aggregated, metrics) -> dict:
+        """Server-side post-round hook with the full per-client parameter
+        stack (the Shapley servers score contributions here, parity with
+        the reference's post-aggregation hooks). Returns extra per-round
+        record fields."""
+        del stacked, sizes, aggregated, metrics
+        return {}
+
     def _process_worker_data(self, data, extra_args):
         del extra_args
         worker_id, dataset_size, params = data
@@ -207,6 +215,7 @@ class ThreadedServer(_QueueServerBase):
             "test_loss": metrics["loss"],
             "round_seconds": time.perf_counter() - self._round_t0,
             **self._record_extra(aggregated),
+            **self._post_round(stacked, sizes, aggregated, metrics),
         }
         self.history.append(record)
         if self.metrics_path:
@@ -341,6 +350,53 @@ class ThreadedFedQuantWorker(ThreadedWorker):
 
     def _upload_payload(self, new_params, key):
         return self._quantize_tree(new_params, self._levels, key)
+
+
+class ThreadedShapleyServer(ThreadedServer):
+    """Shapley contribution scoring through the queue architecture
+    (reference servers/multiround_shapley_value_server.py and
+    GTG_shapley_value_server.py both extend the queue-owning FedServer).
+
+    The server-side post-aggregation hook scores each client from the
+    full per-client upload stack, REUSING the same algorithm strategy
+    objects — and their wave-batched, memoized subset evaluator — as the
+    vmap path (algorithms/shapley.py), so the two execution modes share
+    one implementation of the scoring math."""
+
+    def __init__(self, config: ExperimentConfig, evaluate, eval_batches,
+                 init_params_tree, algorithm, log_dir: str | None = None,
+                 metrics_path: str | None = None):
+        self._shapley = algorithm
+        self._prev_metrics: dict | None = None
+        self._log_dir = log_dir
+        super().__init__(config, evaluate, eval_batches, init_params_tree,
+                         metrics_path=metrics_path)
+
+    def _post_round(self, stacked, sizes, aggregated, metrics) -> dict:
+        from distributed_learning_simulator_tpu.algorithms.base import (
+            RoundContext,
+        )
+
+        ctx = RoundContext(
+            round_idx=self._round,
+            global_params=aggregated,
+            # prev_model is updated AFTER the record is built, so at hook
+            # time it still holds the round's broadcast source — the
+            # empty-coalition model the subset utilities fall back to.
+            prev_global_params=self.prev_model,
+            sizes=sizes,
+            aux={"client_params": stacked},
+            metrics=metrics,
+            prev_metrics=self._prev_metrics,
+            eval_batches=self._eval_batches,
+            log_dir=self._log_dir,
+        )
+        extra = self._shapley.post_round(ctx) or {}
+        self._prev_metrics = metrics
+        return {
+            k: v for k, v in extra.items()
+            if isinstance(v, (int, float, dict))
+        }
 
 
 class ThreadedSignSGDServer(_QueueServerBase):
@@ -503,10 +559,12 @@ def run_threaded_simulation(
 
     config.validate()
     algo_name = config.distributed_algorithm
-    if algo_name not in ("fed", "sign_SGD", "fed_quant"):
+    supported = ("fed", "sign_SGD", "fed_quant", "multiround_shapley_value",
+                 "GTG_shapley_value")
+    if algo_name not in supported:
         raise ValueError(
-            "threaded execution mode supports algorithms 'fed', 'sign_SGD' "
-            f"and 'fed_quant', not {algo_name!r}"
+            f"threaded execution mode supports {supported}, not "
+            f"{algo_name!r}"
         )
     if algo_name == "sign_SGD":
         # Constructor runs the sign_SGD config validation (requires SGD,
@@ -587,6 +645,7 @@ def run_threaded_simulation(
 
     set_level(config.log_level)
     metrics_path = None
+    log_dir = None
     if setup_logging:
         # Same per-run artifact contract as the vmap path: a log file under
         # log/<algo>/<dataset>/<model>/ plus metrics.jsonl next to it.
@@ -666,6 +725,27 @@ def run_threaded_simulation(
                     worker_id, server.worker_data_queue,
                     server.result_queues[worker_id], local_train, shard,
                     config.round, config.seed, levels=q_levels,
+                )
+        elif algo_name in ("multiround_shapley_value", "GTG_shapley_value"):
+            # Shapley = FedAvg training + server-side contribution scoring:
+            # plain FedAvg workers; the scoring reuses the vmap path's
+            # strategy objects through the _post_round hook.
+            from distributed_learning_simulator_tpu.factory import (
+                get_algorithm,
+            )
+
+            shapley = get_algorithm(algo_name, config)
+            shapley.prepare(model.apply, make_eval_fn(model.apply))
+            server = ThreadedShapleyServer(
+                config, evaluate, eval_batches, params, shapley,
+                log_dir=log_dir, metrics_path=metrics_path,
+            )
+
+            def make_worker(worker_id, shard):
+                return ThreadedWorker(
+                    worker_id, server.worker_data_queue,
+                    server.result_queues[worker_id], local_train, shard,
+                    config.round, config.seed,
                 )
         else:
             server = ThreadedServer(config, evaluate, eval_batches, params,

@@ -18,10 +18,11 @@ Usage: python scripts/exp_folded_conv.py [n_chain] [chunk] [batch]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
 import jax.numpy as jnp

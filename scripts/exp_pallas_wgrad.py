@@ -1,0 +1,188 @@
+"""Prototype: Pallas weight-grad for the W-folded stage-1 conv.
+
+XLA's autodiff of the packed-kernel conv computes the grad for ALL
+[3,3,128,128] packed slots (4x the live parameters) and then unpacks —
+~55 ms x 4 convs per round at ~370 GB/s. This kernel computes the
+UNPACKED [3,3,64,64] grad directly as 18 rank-2 MXU contractions —
+true-FLOPs only, one unpacked write.
+
+Mosaic constraints shaped the design (each was hit as a compile error):
+  * no value reshapes across tiled dims -> operate on (B*H'*W')-flattened
+    rows with the 128 channels as lanes;
+  * dynamic/static sublane slice offsets must be multiples of 8 -> pad
+    W' 18 -> 24 so the dy row-offsets are (dy-1)*24, and move the +-1
+    column shifts into 3 HOST-prepared shifted copies of g (the grid's
+    second dimension picks the copy; only 2 of 18 taps need the +-1
+    copies);
+  * 18 fully-unrolled slices overflow the VMEM stack -> one (x, g_v)
+    pair resident per grid step, slices of constant length MP.
+Zero padding on both operands makes every invalid term vanish by
+multiplication (padding rows of g contribute 0; a shifted x partner in
+padding multiplies 0), so there are no masks.
+
+Usage: python scripts/exp_pallas_wgrad.py [n_chain] [chunk]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_learning_simulator_tpu.models.resnet import (
+    pack_folded_kernel,
+)
+
+B, H, WF, C = 25, 32, 16, 64  # folded stage-1 shape, cin = cout = C
+HP, WP = H + 2, 24  # zero-padded spatial dims; WP=24 keeps row
+MP = B * HP * WP    # offsets (dy-1)*WP a multiple of 8 (Mosaic sublanes)
+HALO = WP  # >= max |row offset|; multiple of 8
+
+# (dx, sx) pairs grouped by the column shift v of their tap
+# (u = sx + dx - 1 = 2v + tx).
+_BY_V = {-1: [], 0: [], 1: []}
+for _dx in range(3):
+    for _sx in range(2):
+        _v, _tx = divmod(_sx + _dx - 1, 2)
+        _BY_V[_v].append((_dx, _sx, _tx))
+
+
+TILES = 5  # batch-dim tiles; B=25 -> 5 elements per tile
+BT = B // TILES
+MT = BT * HP * WP  # rows per tile (multiple of 8)
+MTH = MT + 2 * HALO  # haloed tile rows
+
+
+def _wgrad_kernel(x_ref, g_ref, out_ref):
+    """x_ref: [1, 1, MTH, 2C] bf16 (pre-haloed tile); g_ref:
+    [1, 1, 1, MT, 2C] bf16 (this grid step's v-shifted copy, same tile);
+    out_ref: [1, 3, 3, C, C] f32, accumulated over the (v, tile) grid."""
+    vstep = pl.program_id(1)
+    tstep = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(vstep == 0, tstep == 0))
+    def _():
+        out_ref[0] = jnp.zeros((3, 3, C, C), jnp.float32)
+
+    for v_idx, v in enumerate((-1, 0, 1)):
+        @pl.when(vstep == v_idx)
+        def _(v=v):
+            for dx, sx, tx in _BY_V[v]:
+                bm = g_ref[0, 0, 0, :, sx * C:(sx + 1) * C]
+                for dy in range(3):
+                    start = HALO + (dy - 1) * WP
+                    a = x_ref[0, 0, start:start + MT, tx * C:(tx + 1) * C]
+                    part = jax.lax.dot_general(
+                        a, bm,
+                        dimension_numbers=(((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    out_ref[0, dy, dx] = out_ref[0, dy, dx] + part
+
+
+def _prep(xf, gf):
+    """Host-side packing: zero-pad spatially (W' to 24), flatten rows,
+    build overlapping pre-haloed x tiles and the 3 column-shifted g
+    copies (dy shifts never cross a batch element, so tiles on batch
+    boundaries are self-contained up to their zero halos)."""
+    n = xf.shape[0]
+    pad = ((0, 0), (0, 0), (1, 1), (1, 7), (0, 0))
+    xp = jnp.pad(xf, pad)  # [n, B, HP, WP, 2C]
+    gp = jnp.pad(gf, pad)
+    x2 = jnp.pad(
+        xp.reshape(n, MP, 2 * C), ((0, 0), (HALO, HALO), (0, 0))
+    )
+    xt = jnp.stack(
+        [x2[:, t * MT:t * MT + MTH] for t in range(TILES)], axis=1
+    )  # [n, TILES, MTH, 2C]
+    # g shifted by +v along W': term x[.., J+v] g[.., J] == x[.., J']
+    # g[.., J'-v] — shift g so every tap slice is a pure row offset.
+    # roll is safe: the wrapped-around columns are zero padding.
+    g3 = jnp.stack(
+        [jnp.roll(gp, shift=v, axis=3) for v in (-1, 0, 1)], axis=1
+    ).reshape(n, 3, TILES, MT, 2 * C)
+    return xt, g3
+
+
+def pallas_wgrad(xf, gf, interpret=False):
+    """xf/gf: [N, B, H, WF, 2C] -> d_w [N, 3, 3, C, C] f32."""
+    n = xf.shape[0]
+    xt, g3 = _prep(xf, gf)
+    return pl.pallas_call(
+        _wgrad_kernel,
+        grid=(n, 3, TILES),
+        in_specs=[
+            pl.BlockSpec((1, 1, MTH, 2 * C),
+                         lambda c, v, t: (c, t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1, MT, 2 * C),
+                         lambda c, v, t: (c, v, t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 3, 3, C, C),
+                               lambda c, v, t: (c, 0, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 3, 3, C, C), jnp.float32),
+        interpret=interpret,
+    )(xt, g3)
+
+
+def autodiff_wgrad(xf, gf):
+    """Reference: d_w via the packed conv's autodiff (what runs today)."""
+
+    def conv_one(xc, w):
+        wp = pack_folded_kernel(w)
+        return jax.lax.conv_general_dilated(
+            xc, wp, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def loss(w, xc, gc):
+        return jnp.sum((conv_one(xc, w) * gc).astype(jnp.float32))
+
+    w0 = jnp.zeros((3, 3, C, C), jnp.bfloat16)
+    return jax.vmap(
+        lambda xc, gc: jax.grad(loss)(w0, xc, gc)
+    )(xf, gf)
+
+
+def timeit(fn, args, n):
+    out = fn(*args)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    acc = out
+    t0 = time.perf_counter()
+    for _ in range(n):
+        acc = fn(*args)
+    jax.device_get(jax.tree_util.tree_leaves(acc)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    n_chain = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+    chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    key = jax.random.key(0)
+    xf = jax.random.normal(key, (chunk, B, H, WF, 2 * C), jnp.bfloat16)
+    gf = jax.random.normal(jax.random.fold_in(key, 1),
+                           (chunk, B, H, WF, 2 * C), jnp.bfloat16)
+
+    d_ref = jax.jit(autodiff_wgrad)(xf, gf)
+    d_pal = jax.jit(pallas_wgrad)(xf, gf)
+    err = jnp.max(jnp.abs(d_ref.astype(jnp.float32) - d_pal))
+    rel = err / jnp.max(jnp.abs(d_ref.astype(jnp.float32)))
+    print(f"max |err| {float(err):.4f} (rel {float(rel):.2e})")
+
+    t_ref = timeit(jax.jit(autodiff_wgrad), (xf, gf), n_chain)
+    t_pal = timeit(jax.jit(pallas_wgrad), (xf, gf), n_chain)
+    print(f"autodiff packed wgrad: {t_ref*1e3:7.2f} ms | pallas unpacked: "
+          f"{t_pal*1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -171,16 +171,58 @@ def test_threaded_mode_via_config_flag(tiny_config):
     assert len(res["history"]) == 2
 
 
-def test_threaded_rejects_other_algorithms(tiny_config):
+def test_threaded_rejects_unknown_algorithms(tiny_config):
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(tiny_config, distributed_algorithm="bogus")
+    with pytest.raises(ValueError, match="threaded"):
+        run_threaded_simulation(cfg)
+
+
+def test_threaded_shapley_scores_clients(tiny_config):
+    """Shapley through the queue architecture (reference extends the
+    queue-owning FedServer for both Shapley servers): per-round SVs in the
+    history, produced by the SAME strategy objects as the vmap path."""
     from distributed_learning_simulator_tpu.execution.threaded import (
         run_threaded_simulation,
     )
 
     cfg = dataclasses.replace(
-        tiny_config, distributed_algorithm="GTG_shapley_value"
+        tiny_config, distributed_algorithm="multiround_shapley_value",
+        round=2,
     )
-    with pytest.raises(ValueError, match="threaded"):
-        run_threaded_simulation(cfg)
+    res = run_threaded_simulation(cfg, setup_logging=False)
+    assert len(res["history"]) == 2
+    for h in res["history"]:
+        sv = h["shapley_values"]
+        assert set(sv) == set(range(cfg.worker_number))
+        assert all(abs(v) < 10 for v in sv.values())
+
+
+def test_threaded_gtg_matches_vmap_statistically(tiny_config):
+    """Differential oracle for the 5th family: GTG through the queue vs
+    the vmap path — accuracy trajectories agree statistically and both
+    produce finite per-round SVs."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="GTG_shapley_value", round=3,
+    )
+    threaded = run_threaded_simulation(cfg, setup_logging=False)
+    vmapped = run_simulation(cfg, setup_logging=False)
+    a_t = threaded["history"][-1]["test_accuracy"]
+    a_v = vmapped["history"][-1]["test_accuracy"]
+    assert abs(a_t - a_v) < 0.15, (a_t, a_v)
+    import numpy as np
+
+    for res in (threaded, vmapped):
+        sv = res["history"][0]["shapley_values"]
+        assert all(np.isfinite(v) for v in sv.values())
 
 
 def test_threaded_rejects_bf16_local_state(tiny_config):
