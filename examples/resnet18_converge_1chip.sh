@@ -1,0 +1,16 @@
+#!/bin/bash
+# Flagship ResNet-18 convergence run: 1000 clients x 150 rounds at
+# accuracy-bearing hyperparameters (lr 0.02 + cosine decay; the bench lr
+# 0.1 is too hot for the GroupNorm ResNet from scratch at 2 steps/round).
+# Measured (docs/PERFORMANCE.md): final test accuracy 0.9459 (bf16+SR)
+# vs 0.9453 (f32) on the CIFAR-shaped surrogate, with the bf16+SR leg
+# sustaining ~385 clients*rounds/s — the pod-rate margin holds for
+# converged runs, not just short benches.
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name cifar10 --model_name resnet18 \
+  --distributed_algorithm fed \
+  --worker_number 1000 --round 150 --epoch 1 \
+  --learning_rate 0.02 --lr_schedule cosine --lr_min_factor 0.1 \
+  --momentum 0.9 --batch_size 25 \
+  --client_chunk_size 40 --local_compute_dtype bfloat16 \
+  --eval_batch_size 10000 --log_level INFO

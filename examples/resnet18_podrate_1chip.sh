@@ -5,7 +5,8 @@
 # diverged params/grads/momenta live in bf16 with hash-dither stochastic
 # rounding (accuracy parity with f32 — mechanism and negative results in
 # docs/PERFORMANCE.md), halving the round's dominant HBM traffic.
-# Measured: ~335 clients*rounds/s sustained over 50 rounds (f32: ~309).
+# Measured: ~385 clients*rounds/s sustained (round 3, W-folded stage 1;
+# f32: ~343). Accuracy-bearing runs: see resnet18_converge_1chip.sh.
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name cifar10 --model_name resnet18 \
   --distributed_algorithm fed \
