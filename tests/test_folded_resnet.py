@@ -86,6 +86,45 @@ def test_folded_resnet_matches_unfolded():
         ), dtype
 
 
+def test_folded_resnet_gradients_match_unfolded():
+    """The packing transpose (autodiff of the concat/stack kernel build)
+    must route gradients back to the SAME unpacked parameters: compare
+    d loss / d params between folded and unfolded models in f32.
+    Forward equality alone would not catch a scatter/duplication bug in
+    the backward of pack_folded_kernel."""
+    x = np.asarray(
+        jax.random.normal(jax.random.key(5), (4, 32, 32, 3), jnp.float32)
+    )
+    y = np.asarray(
+        jax.random.randint(jax.random.key(6), (4,), 0, 10)
+    )
+    unfolded_model = ResNet18(fold_stage1=False, dtype=jnp.float32)
+    folded_model = ResNet18(fold_stage1=True, dtype=jnp.float32)
+    pu = unfolded_model.init(jax.random.key(0), x[:1])["params"]
+    pf = _transplant(pu, folded_model.init(jax.random.key(0), x[:1])["params"])
+
+    def loss(model, p):
+        logits = model.apply({"params": p}, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    gu = jax.grad(lambda p: loss(unfolded_model, p))(pu)
+    gf = jax.grad(lambda p: loss(folded_model, p))(pf)
+    # Compare via the same transplant mapping, in the folded tree's shape.
+    gu_in_folded = _transplant(gu, gf)
+    for (ku, lu), (kf, lf) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(gu_in_folded),
+               key=lambda kv: str(kv[0])),
+        sorted(jax.tree_util.tree_leaves_with_path(gf),
+               key=lambda kv: str(kv[0])),
+    ):
+        assert str(ku) == str(kf)
+        np.testing.assert_allclose(
+            np.asarray(lf), np.asarray(lu), rtol=2e-3, atol=2e-5,
+            err_msg=str(ku),
+        )
+
+
 def test_folded_param_count_unchanged():
     """Folding changes layout only: identical total parameter count."""
     x = jnp.zeros((1, 32, 32, 3), jnp.float32)
