@@ -20,12 +20,12 @@ compression-ratio reporting. Here:
     and the global model after, per round (parity with the pre/post-
     aggregation accuracy logs at fed_quant_worker.py:55-69 — there each
     worker thread evaluates its own local model; here the per-client evals
-    batch under one vmapped inference program). Deviation, documented: the
-    reference evaluates the worker's raw local QAT model, we evaluate the
-    dequantized 8-bit upload the server actually received — identical up to
-    unbiased quantization noise, and it is the model that enters the
-    aggregate. Disable with ``client_eval=False`` (the per-client stack must
-    materialize, which caps feasible cohort size for large models).
+    batch under one vmapped inference program). The evaluated model is the
+    RAW local QAT model, exactly the reference's observable
+    (fed_quant_worker.py:55-58 evaluates before the quantized upload) —
+    not the dequantized upload. Disable with ``client_eval=False`` (the
+    per-client stack must materialize, which caps feasible cohort size for
+    large models).
 """
 
 from __future__ import annotations

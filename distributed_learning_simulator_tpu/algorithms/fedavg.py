@@ -73,9 +73,18 @@ class FedAvg(Algorithm):
         return client_params, {}
 
     def post_round(self, ctx):
-        client_params = ctx.aux.get("client_params")
-        if not self._client_eval_enabled or client_params is None:
+        if not self._client_eval_enabled:
             return {}
+        client_params = ctx.aux.get("client_params_raw")
+        if client_params is None:
+            # No silent fallback to the payload-transformed stack: that
+            # would quietly revert the telemetry to evaluating the
+            # quantized upload (the deviation this field exists to avoid).
+            raise RuntimeError(
+                "client_eval is enabled but the round produced no raw "
+                "per-client parameter stack (wiring bug in the round "
+                "program)"
+            )
         import numpy as np
 
         if self._client_eval_jit is None:
@@ -130,6 +139,10 @@ class FedAvg(Algorithm):
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0, None))
         keep = self.keep_client_params
+        # Class-level keep = an algorithm that CONSUMES the processed stack
+        # (Shapley); instance-level keep may additionally be set just for
+        # client_eval, which only needs the raw stack.
+        keep_processed = type(self).keep_client_params
         aggregation = cfg.aggregation.lower()
         # Robust rules need every client's params at once (a median has no
         # chunkwise partial sum), so they share the materializing path.
@@ -239,6 +252,14 @@ class FedAvg(Algorithm):
                     client_params = jax.tree_util.tree_map(
                         lambda p: p.astype(jnp.float32), client_params
                     )
+                if self._client_eval_enabled:
+                    # Per-client telemetry evaluates the RAW local model —
+                    # the reference's exact observable (each worker thread
+                    # evaluates its own trained model BEFORE the quantized
+                    # upload, fed_quant_worker.py:55-58) — not the payload-
+                    # transformed upload. For plain fed the transform is
+                    # the identity, so this aliases the same arrays.
+                    aux["client_params_raw"] = client_params
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )
@@ -261,7 +282,11 @@ class FedAvg(Algorithm):
                         ),
                         new_global, global_params,
                     )
-                if keep:
+                if keep_processed:
+                    # Shapley's subset re-averaging consumes the processed
+                    # stack. client_eval does NOT also store it — one
+                    # resident stack, matching what
+                    # _assert_client_stack_feasible budgets for.
                     aux["client_params"] = client_params
                     if idx is not None:
                         aux["participants"] = idx

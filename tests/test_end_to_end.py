@@ -179,6 +179,19 @@ def test_fed_quant_client_eval_telemetry(tiny_config):
     assert last["pre_agg_accuracy_max"] > last["pre_agg_accuracy_min"]
 
 
+def test_fed_quant_client_eval_uses_raw_local_model(tiny_config):
+    """The telemetry evaluates the RAW local QAT model (reference
+    fed_quant_worker.py:55-58), not the quantized upload: at 2-level
+    (1-bit) quantization the dequantized uploads — and the global model
+    aggregated from them — are near-chance, while the raw local models
+    genuinely learn. Under the old dequantized-upload evaluation this
+    gap cannot appear."""
+    res = _run(tiny_config, distributed_algorithm="fed_quant", round=3,
+               quant_levels=2, qat=False)
+    ce = res["history"][-1]["client_eval"]
+    assert ce["pre_agg_accuracy_mean"] > ce["post_agg_accuracy"] + 0.05, ce
+
+
 def test_fed_quant_client_eval_vmap_matches_individual(tiny_config):
     """The vmapped per-client evaluation must equal evaluating each
     client's params individually (guards the in_axes wiring)."""
