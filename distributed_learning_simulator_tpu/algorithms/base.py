@@ -46,7 +46,13 @@ class Algorithm:
     reference factory.py:14-35) and implement ``make_round_fn``."""
 
     name: str = ""
-    # Shapley algorithms need the stacked per-client params in round output.
+    # Public contract: truthy ``keep_client_params`` — set at CLASS level
+    # (Shapley) or on an INSTANCE (third-party subclasses) — makes the round
+    # program materialize every client's parameters and expose the
+    # payload-PROCESSED stack as ``aux['client_params']`` for post_round.
+    # (FedAvg's client_eval telemetry does NOT use this flag: it requests
+    # the RAW pre-payload stack through a private channel, so enabling it
+    # never changes what ``aux['client_params']`` holds.)
     keep_client_params: bool = False
     # Whether the host round loop may defer this algorithm's metric fetch +
     # post_round by one round (hides device->host latency behind the next
@@ -62,6 +68,14 @@ class Algorithm:
 
     def __init__(self, config):
         self.config = config
+
+    @property
+    def materializes_client_stack(self) -> bool:
+        """Whether the round program holds the full [n_clients, params]
+        stack resident (drives the simulator's up-front feasibility check;
+        FedAvg widens this with its client_eval / robust-aggregation
+        materializers)."""
+        return bool(self.keep_client_params)
 
     # ---- jit side ----------------------------------------------------------
     def make_round_fn(

@@ -21,11 +21,12 @@ compression-ratio reporting. Here:
     aggregation accuracy logs at fed_quant_worker.py:55-69 — there each
     worker thread evaluates its own local model; here the per-client evals
     batch under one vmapped inference program). The evaluated model is the
-    RAW local QAT model, exactly the reference's observable
-    (fed_quant_worker.py:55-58 evaluates before the quantized upload) —
-    not the dequantized upload. Disable with ``client_eval=False`` (the
-    per-client stack must materialize, which caps feasible cohort size for
-    large models).
+    local QAT model BEFORE the quantized upload — the reference's
+    observable (fed_quant_worker.py:55-58) — and the inference forward
+    applies the QAT fake-quant transform, matching the reference's
+    QAT-instrumented model at eval time. Disable with
+    ``client_eval=False`` (the per-client stack must materialize, which
+    caps feasible cohort size for large models).
 """
 
 from __future__ import annotations

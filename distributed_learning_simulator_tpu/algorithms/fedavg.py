@@ -57,14 +57,26 @@ class FedAvg(Algorithm):
                     "client_eval=True to force it",
                     config.cohort_size(),
                 )
+        # client_eval materializes the RAW per-client stack through this
+        # private flag — NOT by setting keep_client_params, which is the
+        # documented subclass contract for receiving the payload-processed
+        # stack in aux['client_params'] (base.Algorithm.keep_client_params).
         self._client_eval_enabled = bool(ce)
-        if self._client_eval_enabled:
-            self.keep_client_params = True
         self._eval_fn = None
         self._client_eval_jit = None
 
     def prepare(self, apply_fn, eval_fn):
         self._eval_fn = eval_fn
+
+    @property
+    def materializes_client_stack(self) -> bool:
+        # Single source for "does the round hold the full cohort stack":
+        # make_round_fn allocates by it, the simulator feasibility-checks it.
+        return (
+            self.keep_client_params
+            or self._client_eval_enabled
+            or self.config.aggregation.lower() != "mean"
+        )
 
     # jax-level template hooks, parity with fed_server.py:38-42 -------------
     def process_client_payload(self, client_params, key):
@@ -90,9 +102,22 @@ class FedAvg(Algorithm):
         if self._client_eval_jit is None:
             # One inference program evaluates every client's model: vmap
             # over the stacked params, the padded test batches broadcast.
+            # Inference runs through client_param_transform (fed_quant's QAT
+            # fake-quant) — the reference evaluates the QAT-INSTRUMENTED
+            # model, i.e. fake-quant stays active in its eval forward pass
+            # (fed_quant_worker.py:55-58); for plain fed the transform is
+            # None and this is the raw eval.
+            transform = self.client_param_transform()
+            eval_fn = self._eval_fn
+
+            def eval_one(params, *batches):
+                if transform is not None:
+                    params = transform(params)
+                return eval_fn(params, *batches)
+
             in_axes = (0,) + (None,) * len(ctx.eval_batches)
             self._client_eval_jit = jax.jit(
-                jax.vmap(self._eval_fn, in_axes=in_axes)
+                jax.vmap(eval_one, in_axes=in_axes)
             )
         m = self._client_eval_jit(client_params, *ctx.eval_batches)
         accs = np.asarray(m["accuracy"], dtype=np.float64)
@@ -138,15 +163,17 @@ class FedAvg(Algorithm):
             compute_dtype=compute_dtype,
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0, None))
-        keep = self.keep_client_params
-        # Class-level keep = an algorithm that CONSUMES the processed stack
-        # (Shapley); instance-level keep may additionally be set just for
-        # client_eval, which only needs the raw stack.
-        keep_processed = type(self).keep_client_params
+        # keep_client_params (class OR instance level) = the documented
+        # contract: post_round receives the payload-processed stack as
+        # aux['client_params']. client_eval's raw-stack request rides the
+        # private _client_eval_enabled channel instead.
+        keep_processed = self.keep_client_params
         aggregation = cfg.aggregation.lower()
         # Robust rules need every client's params at once (a median has no
         # chunkwise partial sum), so they share the materializing path.
-        materialize = keep or aggregation != "mean"
+        # The property is the single source — the simulator's feasibility
+        # budget checks the same predicate the round program allocates by.
+        materialize = self.materializes_client_stack
         chunk = cfg.client_chunk_size
         frac = cfg.participation_fraction
         n_participants = cfg.cohort_size(n_clients)
@@ -253,12 +280,14 @@ class FedAvg(Algorithm):
                         lambda p: p.astype(jnp.float32), client_params
                     )
                 if self._client_eval_enabled:
-                    # Per-client telemetry evaluates the RAW local model —
-                    # the reference's exact observable (each worker thread
+                    # Per-client telemetry evaluates the raw LOCAL params —
+                    # the reference's observable (each worker thread
                     # evaluates its own trained model BEFORE the quantized
                     # upload, fed_quant_worker.py:55-58) — not the payload-
-                    # transformed upload. For plain fed the transform is
-                    # the identity, so this aliases the same arrays.
+                    # transformed upload. The eval program itself applies
+                    # client_param_transform (post_round), matching the
+                    # reference's QAT-instrumented eval forward exactly.
+                    # For plain fed both are identities.
                     aux["client_params_raw"] = client_params
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key

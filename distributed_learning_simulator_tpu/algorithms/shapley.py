@@ -184,6 +184,15 @@ class MultiRoundShapley(FedAvg):
     def __init__(self, config):
         super().__init__(config)
         _check_shapley_config(config)
+        if config.worker_number > 16:
+            # Fail at construction — both execution modes build the
+            # algorithm before any training runs, so the bound fires
+            # up-front instead of inside the round-0 post_round.
+            raise ValueError(
+                "exact Shapley needs 2^N subset evaluations; "
+                f"worker_number={config.worker_number} > 16. "
+                "Use GTG_shapley_value for large client counts."
+            )
         self.shapley_values: dict[int, dict[int, float]] = {}
         self._evaluator = None
 
@@ -193,6 +202,8 @@ class MultiRoundShapley(FedAvg):
     def post_round(self, ctx: RoundContext) -> dict:
         n = int(ctx.sizes.shape[0])
         if n > 16:
+            # Backstop for non-worker_number client counts (heterogeneous
+            # client_data overrides); normally caught in __init__.
             raise ValueError(
                 f"exact Shapley needs 2^N subset evaluations; N={n} > 16. "
                 "Use GTG_shapley_value for large client counts."

@@ -29,6 +29,13 @@ class ExperimentConfig:
     optimizer_name: str = "SGD"
     log_level: str = "INFO"
     dataset_args: dict[str, Any] = field(default_factory=dict)
+    # Extra keyword arguments forwarded to the model constructor
+    # (models/registry.py get_model), e.g. {"fold_stage1": false} to disable
+    # the W-folded stage-1 layout on resnet18/34 — required to resume
+    # checkpoints written by pre-fold builds (the fold changes the parameter
+    # TREE STRUCTURE, so resume's structure check rejects mixed configs).
+    # CLI: --model_args '{"fold_stage1": false}' (JSON object).
+    model_args: dict[str, Any] = field(default_factory=dict)
 
     # --- training ----------------------------------------------------------
     batch_size: int = 32
@@ -205,6 +212,11 @@ class ExperimentConfig:
             raise ValueError("participation_fraction must be in (0, 1]")
         if self.compilation_cache_dir in ("", "none", "None"):
             self.compilation_cache_dir = None
+        if not isinstance(self.model_args, dict):
+            raise ValueError(
+                "model_args must be a dict of model-constructor kwargs "
+                '(CLI: a JSON object, e.g. \'{"fold_stage1": false}\')'
+            )
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 
         get_augment(self.augment)  # fail fast on unknown augmentation names
@@ -333,6 +345,15 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
         if f.name == "dataset_args":
             continue
         arg = f"--{f.name}"
+        if f.name == "model_args":
+            import json
+
+            parser.add_argument(
+                arg, type=json.loads, default={},
+                help="JSON object of model-constructor kwargs, e.g. "
+                     '\'{"fold_stage1": false}\'',
+            )
+            continue
         if f.type in ("bool", bool):
             parser.add_argument(arg, type=lambda s: s.lower() in ("1", "true"),
                                 default=f.default)

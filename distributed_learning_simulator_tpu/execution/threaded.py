@@ -574,6 +574,16 @@ def run_threaded_simulation(
         )
 
         SignSGD(config)
+    if algo_name == "multiround_shapley_value":
+        # Constructor runs the exact-Shapley N <= 16 bound up-front
+        # (MultiRoundShapley.__init__): without it, the failure would
+        # surface only inside the round-0 server callback — after threads
+        # spawn and a full round of local training has run.
+        from distributed_learning_simulator_tpu.algorithms.shapley import (
+            MultiRoundShapley,
+        )
+
+        MultiRoundShapley(config)
     if config.server_optimizer_name.lower() not in ("none", ""):
         raise ValueError(
             "threaded execution mode does not support server optimizers; "
@@ -669,7 +679,10 @@ def run_threaded_simulation(
     if client_data is None:
         client_data = build_client_data(config, dataset)
 
-    model = get_model(config.model_name, num_classes=dataset.num_classes)
+    model = get_model(
+        config.model_name, num_classes=dataset.num_classes,
+        **config.model_args,
+    )
     params = init_params(model, dataset.x_train[:1], seed=config.seed)
     optimizer = make_optimizer(
         config.optimizer_name, config.learning_rate,

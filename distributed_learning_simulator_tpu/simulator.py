@@ -143,19 +143,23 @@ def _lr_factor(config, round_idx: int) -> float:
 def _assert_client_stack_feasible(config, global_params, n_clients: int):
     """Refuse the materializing path clearly when it cannot fit.
 
-    Algorithms with ``keep_client_params`` (Shapley scoring, forced
-    client_eval) hold the FULL ``[n_clients, params]`` f32 stack resident —
+    Algorithms whose ``materializes_client_stack`` is true (Shapley scoring,
+    client_eval telemetry, robust aggregation rules) hold the FULL
+    ``[n_clients, params]`` f32 stack resident —
     chunking bounds the training transients, not this stack. At large N x
     large model that dies as a generic device OOM deep inside dispatch;
     mirror MultiRoundShapley's explicit N>16 refusal with a sized error
     instead (same footprint/budget model as _auto_chunk_size)."""
     param_bytes = _f32_param_bytes(global_params)
-    stack_bytes = n_clients * param_bytes
+    # The round program stacks only the SAMPLED cohort (fedavg.round_fn
+    # trains n_participants clients), so that is what must fit.
+    cohort = config.cohort_size(n_clients)
+    stack_bytes = cohort * param_bytes
     budget = _device_budget_bytes(config)
     if stack_bytes > budget:
         raise ValueError(
             f"{config.distributed_algorithm!r} materializes the per-client "
-            f"parameter stack: {n_clients} clients x "
+            f"parameter stack: {cohort} clients x "
             f"{param_bytes / 2**20:.0f} MB = {stack_bytes / 2**30:.1f} GB, "
             f"over the ~{budget / 2**30:.1f} GB device budget "
             f"({config.mesh_devices or 1} device(s)). Use fewer clients, a "
@@ -334,7 +338,10 @@ def run_simulation(
     eval_preprocess = make_reshaper(dataset.x_test.shape[1:])
 
     # --- model / optimizer / algorithm --------------------------------------
-    model = get_model(config.model_name, num_classes=dataset.num_classes)
+    model = get_model(
+        config.model_name, num_classes=dataset.num_classes,
+        **config.model_args,
+    )
     global_params = init_params(model, dataset.x_train[:1], seed=config.seed)
     if config.client_chunk_size == 0:  # auto
         # Resolve into a LOCAL copy: writing back to the caller's config
@@ -356,7 +363,7 @@ def run_simulation(
         momentum=config.momentum, weight_decay=config.weight_decay,
     )
     algorithm = get_algorithm(config.distributed_algorithm, config)
-    if algorithm.keep_client_params:
+    if algorithm.materializes_client_stack:
         _assert_client_stack_feasible(config, global_params, n_clients)
     if config.lr_schedule.lower() != "constant" and not getattr(
         algorithm, "supports_lr_schedule", False

@@ -227,7 +227,15 @@ def test_fed_quant_client_eval_vmap_matches_individual(tiny_config):
     accs = np.asarray(m["accuracy"])
     assert accs.shape == (3,)
     assert accs[0] == accs[1] == accs[2]
-    single = algo._eval_fn(res["global_params"], *eval_batches)
+    # The client-eval program applies the QAT fake-quant transform at
+    # inference (the reference's QAT-instrumented eval forward), so the
+    # single-model comparison must too.
+    transform = algo.client_param_transform()
+    single_params = (
+        transform(res["global_params"]) if transform is not None
+        else res["global_params"]
+    )
+    single = algo._eval_fn(single_params, *eval_batches)
     np.testing.assert_allclose(accs[0], float(single["accuracy"]), atol=1e-6)
 
 
@@ -259,12 +267,15 @@ def test_fed_quant_client_eval_auto_disables_large_cohort(tiny_config):
     from distributed_learning_simulator_tpu.algorithms.fed_quant import FedQuant
 
     big = dataclasses.replace(tiny_config, worker_number=64, client_eval=None)
-    assert FedQuant(big).keep_client_params is False
+    assert FedQuant(big).materializes_client_stack is False
     small = dataclasses.replace(tiny_config, worker_number=8, client_eval=None)
-    assert FedQuant(small).keep_client_params is True
+    assert FedQuant(small).materializes_client_stack is True
     forced = dataclasses.replace(tiny_config, worker_number=64,
                                  client_eval=True)
-    assert FedQuant(forced).keep_client_params is True
+    assert FedQuant(forced).materializes_client_stack is True
+    # client_eval rides a private channel, NOT the keep_client_params
+    # subclass contract (aux['client_params'] stays absent).
+    assert FedQuant(forced).keep_client_params is False
 
 
 def test_fed_quant_client_eval_disabled(tiny_config):

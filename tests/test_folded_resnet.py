@@ -136,6 +136,35 @@ def test_folded_param_count_unchanged():
     assert count(pu) == count(pf)
 
 
+def test_model_args_escape_hatch_disables_fold(tiny_config):
+    """config.model_args={"fold_stage1": False} reaches the constructor
+    through run_simulation — the escape hatch that keeps pre-fold
+    checkpoints resumable (ADVICE r3 medium)."""
+    import dataclasses
+
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(
+        tiny_config, model_name="resnet18", worker_number=2, round=1,
+        batch_size=8, n_train=64, n_test=32,
+        dataset_args={"difficulty": 0.5, "shape": (32, 32, 3)},
+        model_args={"fold_stage1": False},
+    )
+    res = run_simulation(cfg, setup_logging=False)
+    assert not any("Folded" in k for k in res["global_params"])
+    assert np.isfinite(res["history"][-1]["test_loss"])
+
+
+def test_model_args_cli_json():
+    """--model_args parses a JSON object from the CLI."""
+    from distributed_learning_simulator_tpu.config import get_config
+
+    cfg = get_config(
+        ["--model_args", '{"fold_stage1": false}', "--log_level", "WARNING"]
+    )
+    assert cfg.model_args == {"fold_stage1": False}
+
+
 def test_folded_resnet_trains(tiny_config):
     """End-to-end: the folded flagship model learns under the engine."""
     import dataclasses

@@ -181,6 +181,22 @@ def test_threaded_rejects_unknown_algorithms(tiny_config):
         run_threaded_simulation(cfg)
 
 
+def test_threaded_exact_shapley_rejects_large_cohort_up_front(tiny_config):
+    """worker_number > 16 with exact Shapley must fail BEFORE any threads
+    spawn (ADVICE r3: previously it surfaced only inside the round-0 server
+    callback, after a full round of local training)."""
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="multiround_shapley_value",
+        worker_number=17,
+    )
+    with pytest.raises(ValueError, match="2\\^N"):
+        run_threaded_simulation(cfg)
+
+
 def test_threaded_shapley_scores_clients(tiny_config):
     """Shapley through the queue architecture (reference extends the
     queue-owning FedServer for both Shapley servers): per-round SVs in the

@@ -61,7 +61,13 @@ def test_exact_refuses_large_n(tiny_config):
     )
     from distributed_learning_simulator_tpu.algorithms.base import RoundContext
 
+    # Up-front: the constructor refuses before any training could run.
     tiny_config.worker_number = 17
+    with pytest.raises(ValueError, match="2\\^N"):
+        MultiRoundShapley(tiny_config)
+    # Backstop: a round whose actual client count exceeds 16 (heterogeneous
+    # client_data overrides bypass worker_number) still refuses in post_round.
+    tiny_config.worker_number = 4
     algo = MultiRoundShapley(tiny_config)
     ctx = RoundContext(
         round_idx=0, global_params=None, prev_global_params=None,
