@@ -81,9 +81,17 @@ class Algorithm:
     def make_round_fn(
         self, apply_fn: Callable, optimizer, n_clients: int,
         preprocess: Callable | None = None,
+        client_sizes=None,
     ) -> Callable:
         """Return ``round_fn(global_params, client_state, cx, cy, cmask,
         sizes, key[, lr_scale]) -> (new_global, new_client_state, aux)``.
+
+        ``client_sizes`` (optional host numpy ``[n_clients]`` of real
+        per-client sample counts) enables STATIC size-aware work
+        scheduling where the algorithm supports it (FedAvg fused path,
+        config.bucket_client_work); pass None when the client axis is
+        sharded over a mesh (the static regrouping would fight the
+        sharding layout) or when counts aren't known up front.
 
         ``client_state`` is whatever per-client state persists across rounds
         (optimizer/momentum buffers) as a client-stacked pytree; ``aux`` is a

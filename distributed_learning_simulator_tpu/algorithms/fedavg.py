@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from distributed_learning_simulator_tpu.algorithms.base import Algorithm
@@ -144,7 +145,7 @@ class FedAvg(Algorithm):
         return global_params, {}
 
     def make_round_fn(self, apply_fn, optimizer, n_clients: int,
-                      preprocess=None):
+                      preprocess=None, client_sizes=None):
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 
         cfg = self.config
@@ -178,6 +179,50 @@ class FedAvg(Algorithm):
         frac = cfg.participation_fraction
         n_participants = cfg.cohort_size(n_clients)
 
+        # --- size-aware work scheduling (config.bucket_client_work) --------
+        # The packed-shard discipline makes every client scan
+        # shard_size/batch steps — the GLOBAL maximum — even when its real
+        # shard is tiny (Dirichlet skew: the BASELINE configs[4] flagship
+        # has a 5x spread). Host-side, the per-client sample counts are
+        # static data, so the schedule can be static too: sort clients by
+        # needed step count, form chunks in that order, and group chunks by
+        # the steps their largest member needs; each group slices the slot
+        # axis to its own length and runs its own (statically-shaped)
+        # chunked scan. Real-sample coverage per epoch is unchanged — a
+        # client's samples occupy its first slots, always inside the
+        # group's slice — and empty clients are skipped outright (their
+        # aggregation weight is 0 and their metrics are 0 either way).
+        bucket_sizes = None
+        if (
+            client_sizes is not None
+            and getattr(cfg, "bucket_client_work", True)
+            and not materialize
+            and frac >= 1.0
+            and chunk is not None
+            and chunk > 0
+        ):
+            bucket_sizes = np.asarray(client_sizes, dtype=np.int64)
+
+        def _bucket_plan(total_steps: int):
+            """Static schedule: {steps -> client indices} with every nonzero
+            group a union of whole sorted-order chunks (at most the final
+            chunk is partial). Empty clients go straight to the s=0 group —
+            never into a training chunk. Built at trace time (shapes are
+            static under jit)."""
+            steps_c = np.minimum(
+                -(-bucket_sizes // cfg.batch_size), total_steps
+            )
+            groups: dict[int, list[np.ndarray]] = {}
+            empty = np.flatnonzero(steps_c == 0)
+            if empty.size:
+                groups[0] = [empty]
+            nonzero = np.flatnonzero(steps_c > 0)
+            order = nonzero[np.argsort(-steps_c[nonzero], kind="stable")]
+            for start in range(0, order.size, chunk):
+                sl = order[start : start + chunk]
+                groups.setdefault(int(steps_c[sl[0]]), []).append(sl)
+            return {s: np.concatenate(g) for s, g in groups.items()}
+
         def train_clients(global_params, state, x, y, m, keys, lr_scale):
             """Materializing path: returns every client's params stacked
             (needed by Shapley, which re-averages arbitrary subsets)."""
@@ -196,6 +241,33 @@ class FedAvg(Algorithm):
                 one_client, (state, x, y, m, keys), batch_size=chunk
             )
 
+        def make_compute(global_params, lr_scale):
+            """Per-chunk train+reduce body shared by the plain and bucketed
+            fused paths (chunked_accumulate's compute contract)."""
+
+            def compute(chunk_trees, pk):
+                state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
+                cp, ns, tm = vtrain(global_params, state_c, x_c, y_c, m_c,
+                                    keys_c, lr_scale)
+                return reduce_chunk(cp, w_c, pk), (ns, tm)
+
+            return compute
+
+        def reduce_chunk(cp, w, pk):
+            cp, _ = self.process_client_payload(cp, pk)
+            # Weighted partial sum accumulated in f32 even when client
+            # params are bf16 (local_compute_dtype): a sum over up to
+            # 1000 small weighted terms must not round at 8 bits of
+            # mantissa. The MXU takes bf16 inputs with an f32
+            # accumulator natively.
+            return jax.tree_util.tree_map(
+                lambda p: jnp.tensordot(
+                    w.astype(jnp.float32), p, axes=(0, 0),
+                    preferred_element_type=jnp.float32,
+                ),
+                cp,
+            )
+
         def train_and_reduce(global_params, state, x, y, m, keys, norm_w,
                              payload_key, lr_scale):
             """Fused path: per-chunk weighted partial sums accumulate into
@@ -204,21 +276,6 @@ class FedAvg(Algorithm):
             would be ~44 GB, far beyond HBM. Returns (aggregate, new_state,
             train_metrics)."""
             k = keys.shape[0]
-
-            def reduce_chunk(cp, w, pk):
-                cp, _ = self.process_client_payload(cp, pk)
-                # Weighted partial sum accumulated in f32 even when client
-                # params are bf16 (local_compute_dtype): a sum over up to
-                # 1000 small weighted terms must not round at 8 bits of
-                # mantissa. The MXU takes bf16 inputs with an f32
-                # accumulator natively.
-                return jax.tree_util.tree_map(
-                    lambda p: jnp.tensordot(
-                        w.astype(jnp.float32), p, axes=(0, 0),
-                        preferred_element_type=jnp.float32,
-                    ),
-                    cp,
-                )
 
             if chunk is None or chunk >= k:
                 cp, ns, tm = train_clients(
@@ -231,18 +288,66 @@ class FedAvg(Algorithm):
             # the memory-safe path never silently degrades to materializing
             # the full per-client param stack) and splits payload_key into
             # per-chunk keys itself.
-            def compute(chunk_trees, pk):
-                state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
-                cp, ns, tm = vtrain(global_params, state_c, x_c, y_c, m_c,
-                                    keys_c, lr_scale)
-                return reduce_chunk(cp, w_c, pk), (ns, tm)
-
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, global_params)
             agg, (ns, tm) = chunked_accumulate(
-                (state, x, y, m, keys, norm_w), chunk, compute, acc0,
+                (state, x, y, m, keys, norm_w), chunk,
+                make_compute(global_params, lr_scale), acc0,
                 per_chunk=payload_key,
             )
             return agg, ns, tm
+
+        def train_and_reduce_bucketed(plan, global_params, state, x, y, m,
+                                      keys, norm_w, payload_key, lr_scale):
+            """Fused path with the size-aware schedule: one chunked scan per
+            step-count group, each slicing the slot axis to the group's own
+            length. Groups accumulate into the same f32 aggregate; per-client
+            metrics (and persistent state, if any) scatter back to original
+            client positions."""
+            n = keys.shape[0]
+            agg = jax.tree_util.tree_map(jnp.zeros_like, global_params)
+            loss = jnp.zeros((n,), jnp.float32)
+            acc = jnp.zeros((n,), jnp.float32)
+            new_state = state
+            group_keys = jax.random.split(payload_key, len(plan))
+            bsz = cfg.batch_size
+            compute = make_compute(global_params, lr_scale)
+
+            # Descending step count: deterministic group order, big work
+            # first.
+            for gk, (s, idx_np) in zip(
+                group_keys, sorted(plan.items(), reverse=True)
+            ):
+                if s == 0:
+                    # Empty clients: zero aggregation weight and zero
+                    # metrics — identical to "training" them on fully
+                    # masked slots, without the wasted scan.
+                    continue
+                idx = jnp.asarray(idx_np)
+                take = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
+                trees_g = (
+                    jax.tree_util.tree_map(take, state),
+                    take(x)[:, : s * bsz],
+                    take(y)[:, : s * bsz],
+                    take(m)[:, : s * bsz],
+                    keys[idx],
+                    take(norm_w),
+                )
+                if idx_np.size <= chunk:
+                    partial, (ns_g, tm_g) = compute(trees_g, gk)
+                else:
+                    partial, (ns_g, tm_g) = chunked_accumulate(
+                        trees_g, chunk, compute,
+                        jax.tree_util.tree_map(jnp.zeros_like, global_params),
+                        per_chunk=gk,
+                    )
+                agg = jax.tree_util.tree_map(jnp.add, agg, partial)
+                loss = loss.at[idx].set(tm_g["loss"])
+                acc = acc.at[idx].set(tm_g["accuracy"])
+                if state is not None:
+                    new_state = jax.tree_util.tree_map(
+                        lambda full, g: full.at[idx].set(g), new_state, ns_g
+                    )
+            return agg, new_state, {"loss": loss, "accuracy": acc}
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
                      lr_scale=1.0):
@@ -320,10 +425,25 @@ class FedAvg(Algorithm):
                     if idx is not None:
                         aux["participants"] = idx
             else:
-                new_global, new_state_k, train_metrics = train_and_reduce(
-                    global_params, state_k, x_k, y_k, m_k, client_keys,
-                    norm_w, payload_key, lr_scale,
-                )
+                plan = None
+                if bucket_sizes is not None:
+                    plan = _bucket_plan(cx.shape[1] // cfg.batch_size)
+                    if len(plan) <= 1:
+                        # Uniform work: scheduling is a no-op; keep the
+                        # plain path (bit-identical to scheduling-off).
+                        plan = None
+                if plan is not None:
+                    new_global, new_state_k, train_metrics = (
+                        train_and_reduce_bucketed(
+                            plan, global_params, state_k, x_k, y_k, m_k,
+                            client_keys, norm_w, payload_key, lr_scale,
+                        )
+                    )
+                else:
+                    new_global, new_state_k, train_metrics = train_and_reduce(
+                        global_params, state_k, x_k, y_k, m_k, client_keys,
+                        norm_w, payload_key, lr_scale,
+                    )
                 payload_aux = {}
             # Empty effective cohort (all sampled clients have zero samples,
             # possible under extreme Dirichlet skew): keep the previous

@@ -97,7 +97,10 @@ class SignSGD(Algorithm):
         return {"momenta": momenta, "steps": jnp.zeros(n_clients, jnp.int32)}
 
     def make_round_fn(self, apply_fn, optimizer, n_clients: int,
-                      preprocess=None):
+                      preprocess=None, client_sizes=None):
+        # client_sizes (size-aware scheduling) is accepted but unused: the
+        # per-step majority vote synchronizes EVERY client at every
+        # optimizer step, so all clients must run the same step count.
         cfg = self.config
         lr = cfg.learning_rate
         mu = cfg.momentum

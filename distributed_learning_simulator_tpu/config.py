@@ -164,6 +164,16 @@ class ExperimentConfig:
     # diagnostics use (~4x f32 param bytes per in-flight client, 60% of
     # per-device HBM x mesh size), clamped to the cohort.
     client_chunk_size: int | None = None
+    # Size-aware work scheduling for heterogeneous (Dirichlet) shards on the
+    # fused FedAvg path: clients are sorted by sample count and grouped into
+    # chunks whose scan length matches the chunk's LARGEST member, instead
+    # of every client scanning the padded global maximum. Same per-epoch
+    # sample coverage (each real sample still visited exactly once per
+    # epoch); batch composition — hence the exact SGD trajectory — differs
+    # the way any reshuffle does. Skipped automatically when it cannot help
+    # (uniform shards) or cannot apply (mesh/multihost sharding, client
+    # sampling, materializing algorithms, unchunked rounds).
+    bucket_client_work: bool = True
     # Fraction of clients sampled (without replacement) to train+aggregate
     # each round (FedAvg-family). 1.0 = all clients, the reference's fixed
     # behavior; <1.0 is standard FL client sampling — and unlike the

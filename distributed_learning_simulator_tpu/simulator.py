@@ -384,8 +384,15 @@ def run_simulation(
     preprocess = (
         make_decoder(client_data.sample_shape) if client_data.compact else None
     )
+    # Static per-client sample counts feed the size-aware work scheduler
+    # (FedAvg fused path); withheld under mesh/multihost sharding, where the
+    # client axis layout is owned by the PartitionSpec.
+    _sharded = config.multihost or (
+        config.mesh_devices is not None and config.mesh_devices > 1
+    )
     round_fn = algorithm.make_round_fn(
-        model.apply, optimizer, n_clients, preprocess=preprocess
+        model.apply, optimizer, n_clients, preprocess=preprocess,
+        client_sizes=None if _sharded else client_data.sizes,
     )
     round_jit = jax.jit(round_fn, donate_argnums=(1,))
 

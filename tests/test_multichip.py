@@ -91,6 +91,65 @@ def test_chunked_sharded_materializing_path(tiny_config):
     np.testing.assert_allclose(both, base, atol=1e-4)
 
 
+def test_sharded_matches_unsharded_fed_quant(tiny_config):
+    """fed_quant's per-client payload RNG (stochastic quantize keys split
+    inside the round program) under sharding: jax.random values are
+    placement-independent, so the sharded run must match the single-device
+    run to reduction-order tolerance. client_eval off keeps the fused
+    path — the composition the flagship uses at scale."""
+    kw = dict(worker_number=8, round=3, distributed_algorithm="fed_quant",
+              client_eval=False)
+    base = _accs(tiny_config, **kw)
+    sharded = _accs(tiny_config, mesh_devices=8, **kw)
+    np.testing.assert_allclose(sharded, base, atol=1e-4)
+
+
+def test_sharded_client_stack_multiround_shapley(tiny_config):
+    """Exact-Shapley post_round consuming a SHARDED aux['client_params']
+    stack through _SubsetEvaluator (subset weighted means = einsums over
+    the sharded client axis): per-round SVs must match the unsharded run
+    to fp-reduction tolerance."""
+    kw = dict(worker_number=8, round=2,
+              distributed_algorithm="multiround_shapley_value")
+    base = run_simulation(
+        dataclasses.replace(tiny_config, **kw), setup_logging=False
+    )
+    sharded = run_simulation(
+        dataclasses.replace(tiny_config, mesh_devices=8, **kw),
+        setup_logging=False,
+    )
+    for hb, hs in zip(base["history"], sharded["history"]):
+        np.testing.assert_allclose(hs["test_accuracy"], hb["test_accuracy"],
+                                   atol=1e-4)
+        sv_b, sv_s = hb["shapley_values"], hs["shapley_values"]
+        np.testing.assert_allclose(
+            [sv_s[i] for i in sorted(sv_s)], [sv_b[i] for i in sorted(sv_b)],
+            atol=1e-4,
+        )
+
+
+def test_sharded_client_stack_gtg(tiny_config):
+    """GTG's data-dependent permutation walk driven by a sharded client
+    stack (with shapley_eval_samples subsampling the utility evals): SVs
+    finite, accuracy matches the unsharded run."""
+    kw = dict(worker_number=8, round=2,
+              distributed_algorithm="GTG_shapley_value",
+              shapley_eval_samples=64)
+    base = run_simulation(
+        dataclasses.replace(tiny_config, **kw), setup_logging=False
+    )
+    sharded = run_simulation(
+        dataclasses.replace(tiny_config, mesh_devices=8, **kw),
+        setup_logging=False,
+    )
+    np.testing.assert_allclose(
+        sharded["history"][-1]["test_accuracy"],
+        base["history"][-1]["test_accuracy"], atol=1e-4,
+    )
+    sv = sharded["history"][0]["shapley_values"]
+    assert all(np.isfinite(v) for v in sv.values())
+
+
 def test_chunked_sharded_participation_sampling(tiny_config):
     """Client sampling (cohort < population) + chunking + sharding: the
     three execution knobs compose."""

@@ -9,6 +9,7 @@ import dataclasses
 import threading
 import time
 
+import numpy as np
 import pytest
 
 from distributed_learning_simulator_tpu.runtime.native import (
@@ -239,6 +240,74 @@ def test_threaded_gtg_matches_vmap_statistically(tiny_config):
     for res in (threaded, vmapped):
         sv = res["history"][0]["shapley_values"]
         assert all(np.isfinite(v) for v in sv.values())
+
+
+def test_threaded_multiround_shapley_matches_vmap(tiny_config, tmp_path):
+    """Differential oracle for the 5th family (exact multi-round Shapley):
+
+    * trajectories agree statistically (batch orders differ between modes,
+      so trained client params are not bitwise equal);
+    * the SV COMPUTATION is exact on both paths: each mode's per-round SVs
+      are recomputed in this test from that mode's own logged subset-utility
+      table (metric_<round>.pkl, the reference's artifact) with an
+      INDEPENDENT permutation-form Shapley implementation, and must match
+      to float tolerance — plus the efficiency axiom
+      sum_i SV_i = U(grand) - U(empty).
+    """
+    import glob
+    import itertools
+    import pickle as pkl
+
+    from distributed_learning_simulator_tpu.execution.threaded import (
+        run_threaded_simulation,
+    )
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    def perm_shapley(utilities, n):
+        """Independent exact SV: average marginal over all n! orderings."""
+        sv = np.zeros(n)
+        perms = list(itertools.permutations(range(n)))
+        for perm in perms:
+            pre = frozenset()
+            for i in perm:
+                u_pre = utilities[tuple(sorted(pre))]
+                u_post = utilities[tuple(sorted(pre | {i}))]
+                sv[i] += u_post - u_pre
+                pre = pre | {i}
+        return sv / len(perms)
+
+    results = {}
+    for mode, runner in (("threaded", run_threaded_simulation),
+                         ("vmap", run_simulation)):
+        cfg = dataclasses.replace(
+            tiny_config, distributed_algorithm="multiround_shapley_value",
+            round=2, log_root=str(tmp_path / mode), log_level="WARNING",
+        )
+        res = runner(cfg, setup_logging=True)
+        pickles = sorted(glob.glob(
+            str(tmp_path / mode / "**" / "metric_*.pkl"), recursive=True
+        ))
+        assert len(pickles) == 2, pickles
+        for path in pickles:
+            round_idx = int(path.rsplit("_", 1)[1].split(".")[0])
+            with open(path, "rb") as f:
+                utilities = pkl.load(f)
+            assert len(utilities) == 2 ** cfg.worker_number
+            sv_logged = res["history"][round_idx]["shapley_values"]
+            sv_ref = perm_shapley(utilities, cfg.worker_number)
+            np.testing.assert_allclose(
+                [sv_logged[i] for i in range(cfg.worker_number)], sv_ref,
+                rtol=1e-8, atol=1e-10,
+            )
+            grand = utilities[tuple(range(cfg.worker_number))]
+            empty = utilities[()]
+            np.testing.assert_allclose(
+                sum(sv_logged.values()), grand - empty, rtol=1e-6, atol=1e-9
+            )
+        results[mode] = res
+    a_t = results["threaded"]["history"][-1]["test_accuracy"]
+    a_v = results["vmap"]["history"][-1]["test_accuracy"]
+    assert abs(a_t - a_v) < 0.15, (a_t, a_v)
 
 
 def test_threaded_rejects_bf16_local_state(tiny_config):
