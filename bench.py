@@ -173,6 +173,36 @@ def main():
             "compile_s": round(fr["compile_s"], 2),
         }
 
+    # Deterministic regression proxy (VERDICT r3 weak #6): the cnn headline's
+    # wall-clock band on identical code spans 8.3-11.2k c*r/s (host jitter on
+    # ~100 ms rounds through the shared tunnel), hiding sub-25% regressions.
+    # XLA's raw_bytes_accessed, summed over a short traced run, is a pure
+    # function of the compiled program — identical across runs, moved only
+    # by real program changes (lost fusion, extra copies, layout padding).
+    run_proxy = (
+        os.environ.get("BENCH_PROXY", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_proxy:
+        import dataclasses
+        import tempfile
+
+        from distributed_learning_simulator_tpu.utils.tracing import (
+            parse_device_trace,
+        )
+
+        with tempfile.TemporaryDirectory() as td:
+            p_config = dataclasses.replace(config, round=3, profile_dir=td)
+            _run(p_config, dataset=dataset, client_data=client_data)
+            stats = parse_device_trace(td)
+        record["proxy"] = {
+            "traced_bytes_gb": round(stats["bytes_gb"], 3),
+            "traced_device_ms": round(stats["device_ms"], 1),
+            "traced_op_count": stats["op_count"],
+            "trace_rounds": 3,
+        }
+
     print(json.dumps(record))
 
 

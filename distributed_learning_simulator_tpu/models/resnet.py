@@ -93,6 +93,55 @@ def pack_folded_pointwise_stride2(w):
     return jnp.concatenate([w, jnp.zeros_like(w)], axis=2)
 
 
+def pack_folded_stem_kernel(w):
+    """``[3, 3, cin, cout] -> [3, 4, cin, 2cout]``: stride-1 SAME 3x3 conv
+    on the UNFOLDED input emitting the FOLDED layout directly.
+
+    Folded output pixel (J, tx in {0, 1}) holds unfolded column 2J+tx in
+    channel block tx*cout; tap dx reads input column 2J + (tx+dx-1) =
+    2J + (k-1) with k = tx+dx in {0..3} — a (3, 4)-tap conv at column
+    stride 2 with explicit (1, 1) column padding. Six live placements in
+    twelve slots; with it, no unfolded stage-1 activation ever
+    materializes (the fold 'reshape' at the stem boundary is physically a
+    relayout copy, and its f32 GroupNorm-backward intermediates were
+    measured at 348-420 GB/s on lane-padded [.., W, 64] tensors —
+    docs/PERFORMANCE.md round 4)."""
+    zero = jnp.zeros(w.shape[:1] + w.shape[2:], w.dtype)  # [3, cin, cout]
+
+    def tap(k, tx):
+        dx = k - tx
+        return w[:, dx] if 0 <= dx <= 2 else zero
+
+    ks = [
+        jnp.concatenate([tap(k, 0), tap(k, 1)], axis=-1)  # [3, cin, 2cout]
+        for k in range(4)
+    ]
+    return jnp.stack(ks, axis=1)  # [3(ky), 4(k), cin, 2cout]
+
+
+class FoldedStemConv(nn.Module):
+    """CIFAR stem conv producing the W-folded stage-1 layout directly.
+
+    The parameter is the ordinary unfolded ``[3, 3, cin, features]`` kernel
+    under the same auto-name/shape/init as the ``nn.Conv`` stem it replaces
+    (instantiate with ``name="Conv_0"`` for checkpoint-identical trees)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, x.shape[-1], self.features), jnp.float32,
+        )
+        wp = pack_folded_stem_kernel(kernel.astype(self.dtype))
+        return jax.lax.conv_general_dilated(
+            x.astype(self.dtype), wp, (1, 2), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
 class FoldedConv3x3(nn.Module):
     """Stride-1 SAME 3x3 conv on the W-folded layout ``[B, H, W/2, 2cin]``.
 
@@ -275,27 +324,42 @@ class ResNet18(nn.Module):
     @nn.compact
     def __call__(self, x):
         x = x.astype(self.dtype)
+        # Fold applicability: stage 0 is stride-1 at width 64 with even
+        # spatial dims (even W: the fold pairs columns; even H: the
+        # transition block's stride-2 row taps assume SAME's (0, 1)
+        # padding). The stem preserves spatial dims, so the input decides.
+        fold_ok = (
+            self.fold_stage1
+            and self.width == 64
+            and x.shape[1] % 2 == 0
+            and x.shape[2] % 2 == 0
+        )
         # CIFAR-style stem (3x3, no initial downsample) for 32x32 inputs.
-        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype)(x)
-        x = nn.GroupNorm(num_groups=min(32, self.width), dtype=self.dtype)(x)
-        x = nn.relu(x)
+        # When folding, the stem itself emits the folded layout (name= pins
+        # keep the parameter tree identical to the unfolded stem's): no
+        # unfolded 64-channel activation — nor its lane-padded
+        # GroupNorm-backward intermediates — ever materializes.
         folded = False
+        if fold_ok:
+            x = FoldedStemConv(
+                self.width, dtype=self.dtype, name="Conv_0"
+            )(x)
+            x = FoldedGroupNorm(
+                num_groups=min(32, self.width), dtype=self.dtype,
+                name="GroupNorm_0",
+            )(x)
+            x = nn.relu(x)
+            folded = True
+        else:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
+            x = nn.GroupNorm(
+                num_groups=min(32, self.width), dtype=self.dtype
+            )(x)
+            x = nn.relu(x)
         for stage, n_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**stage)
-            if (
-                stage == 0
-                and self.fold_stage1
-                and features == 64
-                # Even W: the fold pairs columns. Even H: the transition
-                # block's stride-2 row taps assume SAME's (0, 1) padding,
-                # which only matches at even H.
-                and x.shape[1] % 2 == 0
-                and x.shape[2] % 2 == 0
-            ):
-                b, h, w, c = x.shape
-                x = x.reshape(b, h, w // 2, 2 * c)  # pure reshape fold
-                folded = True
+            if stage == 0 and folded:
                 for block in range(n_blocks):
                     x = FoldedResidualBlock(features, dtype=self.dtype)(x)
                 continue

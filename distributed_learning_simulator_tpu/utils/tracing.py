@@ -13,8 +13,67 @@ Usage: set ``config.profile_dir`` — the simulator wraps the run in
 from __future__ import annotations
 
 import contextlib
+import glob
+import gzip
+import json
+import os
 
 import jax
+
+
+def iter_device_ops(trace_dir: str):
+    """Yield device-lane op events from a jax.profiler trace directory.
+
+    The ONE copy of the event-selection rule (shared by
+    :func:`parse_device_trace` and the profiling scripts): complete ('X')
+    events carrying XLA op annotations (``long_name`` or
+    ``raw_bytes_accessed``), with parent ``while``/``jit(...)`` frames
+    excluded — those wrap their children's time and would double count.
+    Missing/empty trace dirs yield nothing rather than raising.
+    """
+    paths = glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*",
+                     "*.trace.json.gz")
+    )
+    for path in sorted(paths, key=os.path.getmtime):
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            if "long_name" not in args and "raw_bytes_accessed" not in args:
+                continue
+            name = ev.get("name", "")
+            if name.startswith("while") or name.startswith("jit("):
+                continue
+            yield ev
+
+
+def parse_device_trace(trace_dir: str) -> dict:
+    """Aggregate device-op statistics from a jax.profiler trace directory.
+
+    Returns ``{"device_ms", "bytes_gb", "op_count"}`` summed over
+    :func:`iter_device_ops`. ``bytes_gb`` sums XLA's ``raw_bytes_accessed``
+    — a DETERMINISTIC function of the compiled program (identical across
+    runs of the same program on the same shapes), which makes it the
+    environment-robust regression proxy bench.py emits: host contention
+    moves wall-clock but cannot move the bytes the program accesses.
+    CPU traces without byte annotations report zero bytes.
+    """
+    device_us = 0.0
+    bytes_total = 0.0
+    op_count = 0
+    for ev in iter_device_ops(trace_dir):
+        args = ev.get("args") or {}
+        device_us += float(ev.get("dur", 0.0))
+        bytes_total += float(args.get("raw_bytes_accessed", 0) or 0)
+        op_count += 1
+    return {
+        "device_ms": device_us / 1e3,
+        "bytes_gb": bytes_total / 2**30,
+        "op_count": op_count,
+    }
 
 
 def annotate(name: str):

@@ -23,6 +23,25 @@ def test_profile_session_writes_trace(tmp_path):
     assert (tmp_path / "trace").exists()
 
 
+def test_parse_device_trace_shape_and_robustness(tmp_path):
+    """parse_device_trace returns the proxy dict for a real trace dir and
+    zeros (not an exception) for an empty one."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.utils.tracing import (
+        parse_device_trace,
+    )
+
+    with profile_session(str(tmp_path / "trace")):
+        _ = jax.jit(lambda x: (x * 2).sum())(jnp.ones(64)).block_until_ready()
+    stats = parse_device_trace(str(tmp_path / "trace"))
+    assert set(stats) == {"device_ms", "bytes_gb", "op_count"}
+    assert stats["device_ms"] >= 0.0 and stats["bytes_gb"] >= 0.0
+    empty = parse_device_trace(str(tmp_path / "nonexistent"))
+    assert empty == {"device_ms": 0.0, "bytes_gb": 0.0, "op_count": 0}
+
+
 def test_multihost_initialize_single_process():
     """On a single process, initialize is a no-op that reports devices."""
     from distributed_learning_simulator_tpu.parallel.multihost import (
