@@ -108,9 +108,20 @@ def cap_eval_batches(eval_batches, max_samples: int | None):
 
 
 class _SubsetEvaluator:
-    """Chunked, memoized evaluation of subset-model test metrics."""
+    """Chunked, memoized evaluation of subset-model test metrics.
 
-    def __init__(self, eval_fn):
+    ``chunk`` (config.shapley_eval_chunk) sets how many subset models one
+    batched XLA call materializes+evaluates. Each call re-reads the full
+    ``[n_clients, params]`` stack for its weighted means, so a larger
+    chunk amortizes that read across more subsets — at N=1000 (1.8 GB
+    stack) chunk 16 re-reads ~30 TB over a 266k-subset round; chunk 64
+    cuts it 4x. The ceiling is activation memory: chunk models x
+    eval-batch activations live at once.
+    """
+
+    def __init__(self, eval_fn, chunk: int = _EVAL_CHUNK):
+        self._chunk = int(chunk)
+
         # eval_fn(params, xb, yb, mb) -> {'loss','accuracy'}
         def eval_one(client_params, sizes, mask, prev_global, xb, yb, mb):
             params = subset_weighted_mean(client_params, sizes, mask, prev_global)
@@ -128,10 +139,11 @@ class _SubsetEvaluator:
         through a tunnel), which dominated GTG rounds at large N.
         """
         xb, yb, mb = eval_batches
+        size = self._chunk
         pending = []
-        for start in range(0, len(masks), _EVAL_CHUNK):
-            chunk = masks[start : start + _EVAL_CHUNK]
-            pad = _EVAL_CHUNK - len(chunk)
+        for start in range(0, len(masks), size):
+            chunk = masks[start : start + size]
+            pad = size - len(chunk)
             if pad:
                 chunk = np.concatenate(
                     [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
@@ -139,7 +151,7 @@ class _SubsetEvaluator:
             vals = self._eval_chunk(
                 client_params, sizes, jnp.asarray(chunk), prev_global, xb, yb, mb
             )
-            pending.append(vals[: _EVAL_CHUNK - pad] if pad else vals)
+            pending.append(vals[: size - pad] if pad else vals)
         return np.concatenate(jax.device_get(pending))
 
 
@@ -197,7 +209,10 @@ class MultiRoundShapley(FedAvg):
         self._evaluator = None
 
     def prepare(self, apply_fn, eval_fn):
-        self._evaluator = _SubsetEvaluator(eval_fn)
+        self._evaluator = _SubsetEvaluator(
+            eval_fn,
+            chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
+        )
 
     def post_round(self, ctx: RoundContext) -> dict:
         n = int(ctx.sizes.shape[0])
@@ -285,7 +300,10 @@ class GTGShapley(FedAvg):
         self._rng = np.random.default_rng(getattr(config, "seed", 0) + 17)
 
     def prepare(self, apply_fn, eval_fn):
-        self._evaluator = _SubsetEvaluator(eval_fn)
+        self._evaluator = _SubsetEvaluator(
+            eval_fn,
+            chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
+        )
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
         converge_min = max(30, n)  # GTG_shapley_value_server.py:15

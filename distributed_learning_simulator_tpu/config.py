@@ -136,6 +136,13 @@ class ExperimentConfig:
     # set per round); Monte-Carlo SV noise dwarfs eval-subsampling noise,
     # so a few-thousand-sample cap buys a near-linear round-time cut.
     shapley_eval_samples: int | None = None
+    # Subset models evaluated per batched XLA call by the Shapley subset
+    # evaluator. Each call re-reads the full [n_clients, params] stack for
+    # its weighted means, so at large N a larger chunk amortizes that read
+    # across more subsets (N=1000 cnn_tpu: the stack is 1.8 GB); the
+    # ceiling is activation memory (chunk models x eval-batch activations
+    # resident at once).
+    shapley_eval_chunk: int = 16
 
     # --- execution ----------------------------------------------------------
     # "vmap": the fast path — one jitted round program over the client axis.
@@ -305,6 +312,8 @@ class ExperimentConfig:
             and self.shapley_eval_samples < 1
         ):
             raise ValueError("shapley_eval_samples must be >= 1 or None")
+        if self.shapley_eval_chunk < 1:
+            raise ValueError("shapley_eval_chunk must be >= 1")
         if self.lr_schedule.lower() not in ("constant", "cosine", "step"):
             raise ValueError(
                 f"unknown lr_schedule {self.lr_schedule!r}; known: "

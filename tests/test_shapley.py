@@ -55,6 +55,30 @@ def test_symmetry_property():
     np.testing.assert_allclose(sv, sv[0])
 
 
+def test_shapley_eval_chunk_invariant(tiny_config):
+    """shapley_eval_chunk is pure batching: per-round SVs must be identical
+    across chunk sizes (including one that doesn't divide the subset
+    count)."""
+    import dataclasses
+
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    svs = []
+    for chunk in (16, 5, 64):
+        cfg = dataclasses.replace(
+            tiny_config, distributed_algorithm="multiround_shapley_value",
+            round=2, shapley_eval_chunk=chunk,
+        )
+        res = run_simulation(cfg, setup_logging=False)
+        svs.append([h["shapley_values"] for h in res["history"]])
+    for other in svs[1:]:
+        for h0, h1 in zip(svs[0], other):
+            np.testing.assert_allclose(
+                [h0[i] for i in sorted(h0)], [h1[i] for i in sorted(h1)],
+                rtol=1e-6, atol=1e-9,
+            )
+
+
 def test_exact_refuses_large_n(tiny_config):
     from distributed_learning_simulator_tpu.algorithms.shapley import (
         MultiRoundShapley,
