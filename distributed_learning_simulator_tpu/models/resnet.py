@@ -27,6 +27,7 @@ docs/PERFORMANCE.md.
 
 from __future__ import annotations
 
+import functools
 from typing import Sequence
 
 import flax.linen as nn
@@ -166,6 +167,73 @@ class FoldedConv3x3(nn.Module):
         )
 
 
+def _fgn_forward(xf, scale, bias, g: int, eps: float, out_dtype):
+    """Folded-layout GroupNorm forward; returns (y, mean, rstd)."""
+    b, h, wf, c2 = xf.shape
+    c = c2 // 2
+    cpg = c // g
+    x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
+    # One-pass statistics (E[x^2] - E[x]^2, flax's use_fast_variance):
+    # the two-pass (x - mean)^2 form reads the activations twice and
+    # measurably halves this fusion's effective bandwidth. (An
+    # indicator-matrix matmul formulation of the group reduction was
+    # also tried — identical round time, so the simpler form stays.)
+    mean = jnp.mean(x, axis=(1, 2, 3, 5), keepdims=True)
+    mean2 = jnp.mean(jnp.square(x), axis=(1, 2, 3, 5), keepdims=True)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    norm = ((x - mean) * rstd).reshape(b, h, wf, c2)
+    y = (norm * jnp.tile(scale, 2) + jnp.tile(bias, 2)).astype(out_dtype)
+    return y, mean, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _folded_group_norm(xf, scale, bias, g: int, eps: float, out_dtype):
+    return _fgn_forward(xf, scale, bias, g, eps, out_dtype)[0]
+
+
+def _fgn_fwd(xf, scale, bias, g, eps, out_dtype):
+    y, mean, rstd = _fgn_forward(xf, scale, bias, g, eps, out_dtype)
+    return y, (xf, scale, mean, rstd)
+
+
+def _fgn_bwd(g: int, eps: float, out_dtype, res, dy):
+    """Canonical closed-form GN backward, two activation passes.
+
+    XLA autodiff of the E[x^2]-E[x]^2 forward emits a chain of separate
+    stat reduces over the stage-1 activations (measured 243 GB/s,
+    ~211 ms/round on the flagship — docs/PERFORMANCE.md round 4); the
+    closed form needs one fused reduce pass (m1, m2, dscale, dbias share
+    the same two inputs) and one elementwise pass for dx:
+
+      dx = rstd * (dy*scale - mean_grp(dy*scale)
+                   - xhat * mean_grp(dy*scale * xhat))
+    """
+    xf, scale, mean, rstd = res
+    b, h, wf, c2 = xf.shape
+    c = c2 // 2
+    cpg = c // g
+    x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
+    xhat = (x - mean) * rstd
+    dy32 = dy.astype(jnp.float32)
+    dyg = (dy32 * jnp.tile(scale, 2)).reshape(b, h, wf, 2, g, cpg)
+    m1 = jnp.mean(dyg, axis=(1, 2, 3, 5), keepdims=True)
+    m2 = jnp.mean(dyg * xhat, axis=(1, 2, 3, 5), keepdims=True)
+    dx = (rstd * (dyg - m1 - xhat * m2)).reshape(b, h, wf, c2)
+    dyx = (dy32.reshape(b, h, wf, 2, g, cpg) * xhat).reshape(b, h, wf, c2)
+    # Per-channel param grads: both tx placements of channel c accumulate.
+    # Cotangent dtypes must match the incoming params' dtypes (bf16 when
+    # the engine runs local_compute_dtype=bfloat16).
+    dscale = jnp.sum(dyx, axis=(0, 1, 2))
+    dscale = (dscale[:c] + dscale[c:]).astype(scale.dtype)
+    dbias = jnp.sum(dy32, axis=(0, 1, 2))
+    dbias = (dbias[:c] + dbias[c:]).astype(scale.dtype)
+    return dx.astype(xf.dtype), dscale, dbias
+
+
+_folded_group_norm.defvjp(_fgn_fwd, _fgn_bwd)
+
+
 class FoldedGroupNorm(nn.Module):
     """GroupNorm computed directly ON the folded layout.
 
@@ -179,34 +247,28 @@ class FoldedGroupNorm(nn.Module):
     reduce over ``(H, Wf, tx, cpg)`` — same elements as the unfolded
     norm, never leaving the folded layout. scale/bias are per-channel
     ``[C]`` (identical to ``nn.GroupNorm``'s params), tiled across tx.
+    The backward is the hand-written closed form (:func:`_fgn_bwd`);
+    ``custom_backward=False`` restores plain autodiff.
     """
 
     num_groups: int
     dtype: jnp.dtype = jnp.bfloat16
     epsilon: float = 1e-6
+    custom_backward: bool = True
 
     @nn.compact
     def __call__(self, xf):
-        b, h, wf, c2 = xf.shape
-        c = c2 // 2
-        g = self.num_groups
-        cpg = c // g
+        c = xf.shape[-1] // 2
         scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
-        x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
-        # One-pass statistics (E[x^2] - E[x]^2, flax's use_fast_variance):
-        # the two-pass (x - mean)^2 form reads the activations twice and
-        # measurably halves this fusion's effective bandwidth. (An
-        # indicator-matrix matmul formulation of the group reduction was
-        # also tried — identical round time, so the simpler form stays.)
-        mean = jnp.mean(x, axis=(1, 2, 3, 5), keepdims=True)
-        mean2 = jnp.mean(jnp.square(x), axis=(1, 2, 3, 5), keepdims=True)
-        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
-        norm = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
-        norm = norm.reshape(b, h, wf, c2)
-        return (
-            norm * jnp.tile(scale, 2) + jnp.tile(bias, 2)
-        ).astype(self.dtype)
+        if self.custom_backward:
+            return _folded_group_norm(
+                xf, scale, bias, self.num_groups, self.epsilon, self.dtype
+            )
+        y, _, _ = _fgn_forward(
+            xf, scale, bias, self.num_groups, self.epsilon, self.dtype
+        )
+        return y
 
 
 class FoldedResidualBlock(nn.Module):

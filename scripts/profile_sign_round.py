@@ -1,11 +1,13 @@
-"""Device-trace profile of the sign_SGD round at ResNet scale.
+"""Device-trace profile of one round at ResNet scale (any algorithm).
 
 Round-3 method (docs/PERFORMANCE.md): jax.profiler works through the
 tunnel; the device lane events in vm.trace.json.gz carry per-op ``dur``
 and ``raw_bytes_accessed``, which is the only reliable attribution of
 round time (isolated microbenches lie — measured round 3).
 
-Usage: python scripts/profile_sign_round.py [chunk] [trace_dir]
+Usage: python scripts/profile_sign_round.py [chunk] [trace_dir] [algo] [dtype]
+(algo default sign_SGD; dtype default float32 — use bfloat16 for the fed
+flagship configuration.)
 """
 
 from __future__ import annotations
@@ -21,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 
-def build_round(chunk: int):
+def build_round(chunk: int, algo: str = "sign_SGD", dtype: str = "float32"):
     from distributed_learning_simulator_tpu.config import ExperimentConfig
     from distributed_learning_simulator_tpu.data.registry import get_dataset
     from distributed_learning_simulator_tpu.factory import get_algorithm
@@ -36,22 +38,26 @@ def build_round(chunk: int):
     )
     from distributed_learning_simulator_tpu.simulator import build_client_data
 
+    momentum = 0.0 if algo == "sign_SGD" else 0.9
     config = ExperimentConfig(
         dataset_name="cifar10", model_name="resnet18",
-        distributed_algorithm="sign_SGD", worker_number=1000, round=3,
-        epoch=1, learning_rate=0.01, momentum=0.0, batch_size=25,
+        distributed_algorithm=algo, worker_number=1000, round=3,
+        epoch=1, learning_rate=0.01, momentum=momentum, batch_size=25,
         log_level="WARNING", client_chunk_size=chunk,
+        local_compute_dtype=dtype,
     )
     dataset = get_dataset(config.dataset_name, seed=0)
     client_data = build_client_data(config, dataset)
     model = get_model(config.model_name, num_classes=dataset.num_classes)
     params = init_params(model, dataset.x_train[:1], seed=0)
-    optimizer = make_optimizer("SGD", config.learning_rate)
-    algorithm = get_algorithm("sign_SGD", config)
+    optimizer = make_optimizer("SGD", config.learning_rate,
+                               momentum=momentum)
+    algorithm = get_algorithm(algo, config)
     algorithm.prepare(model.apply, make_eval_fn(model.apply))
     round_fn = algorithm.make_round_fn(
         model.apply, optimizer, client_data.n_clients,
         preprocess=make_decoder(client_data.sample_shape),
+        client_sizes=client_data.sizes,
     )
     round_jit = jax.jit(round_fn)
     operands = (
@@ -95,7 +101,9 @@ def parse_trace(trace_dir: str, top: int = 30):
 def main():
     chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 40
     trace_dir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/sign_trace"
-    round_jit, operands = build_round(chunk)
+    algo = sys.argv[3] if len(sys.argv) > 3 else "sign_SGD"
+    dtype = sys.argv[4] if len(sys.argv) > 4 else "float32"
+    round_jit, operands = build_round(chunk, algo, dtype)
     key = jax.random.key(1)
 
     t0 = time.perf_counter()
