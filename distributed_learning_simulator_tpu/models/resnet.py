@@ -194,7 +194,9 @@ def _folded_group_norm(xf, scale, bias, g: int, eps: float, out_dtype):
 
 def _fgn_fwd(xf, scale, bias, g, eps, out_dtype):
     y, mean, rstd = _fgn_forward(xf, scale, bias, g, eps, out_dtype)
-    return y, (xf, scale, mean, rstd)
+    # bias rides along only for its dtype (cotangents must match primal
+    # dtypes); it is a [C] vector, so the residual cost is nil.
+    return y, (xf, scale, bias, mean, rstd)
 
 
 def _fgn_bwd(g: int, eps: float, out_dtype, res, dy):
@@ -209,7 +211,7 @@ def _fgn_bwd(g: int, eps: float, out_dtype, res, dy):
       dx = rstd * (dy*scale - mean_grp(dy*scale)
                    - xhat * mean_grp(dy*scale * xhat))
     """
-    xf, scale, mean, rstd = res
+    xf, scale, bias, mean, rstd = res
     b, h, wf, c2 = xf.shape
     c = c2 // 2
     cpg = c // g
@@ -227,7 +229,7 @@ def _fgn_bwd(g: int, eps: float, out_dtype, res, dy):
     dscale = jnp.sum(dyx, axis=(0, 1, 2))
     dscale = (dscale[:c] + dscale[c:]).astype(scale.dtype)
     dbias = jnp.sum(dy32, axis=(0, 1, 2))
-    dbias = (dbias[:c] + dbias[c:]).astype(scale.dtype)
+    dbias = (dbias[:c] + dbias[c:]).astype(bias.dtype)
     return dx.astype(xf.dtype), dscale, dbias
 
 

@@ -4,9 +4,10 @@
 # --max_shard_size with 0/1 masks (empty clients get zero aggregation
 # weight). Size-aware work scheduling (config.bucket_client_work, on by
 # default) sorts clients by shard size and scans each chunk only as far as
-# its largest member — with the folded stem, 2.61 s/round (383
-# clients*rounds/s, 1.15x pod-rate) on one chip at shard cap 100 with
-# chunk 40, vs 5.01 s/round in round 3 (docs/PERFORMANCE.md, round 4).
+# its largest member — with the folded stem + closed-form GroupNorm
+# backward, 2.55 s/round (392 clients*rounds/s, 1.18x pod-rate) on one
+# chip at shard cap 100 with chunk 40, vs 5.01 s/round in round 3
+# (docs/PERFORMANCE.md, round 4).
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name cifar10 --model_name resnet18 \
   --distributed_algorithm fed \

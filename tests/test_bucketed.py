@@ -145,6 +145,18 @@ def test_bucketed_fed_quant_composes(tiny_config):
     assert np.isfinite(res["history"][-1]["test_loss"])
 
 
+def test_dirichlet_with_sampling_skips_scheduler(tiny_config):
+    """Client sampling gates the scheduler off (per-round cohorts change);
+    a Dirichlet + participation_fraction < 1 run must still work."""
+    res = _run(
+        tiny_config, round=3, worker_number=8, client_chunk_size=2,
+        partition="dirichlet", dirichlet_alpha=0.5, n_train=1024,
+        participation_fraction=0.5,
+    )
+    assert len(res["history"]) == 3
+    assert all(np.isfinite(h["test_accuracy"]) for h in res["history"])
+
+
 def test_bucketed_respects_weighting(tiny_config):
     """Aggregation weights ride the original sizes: a giant client must
     dominate the aggregate regardless of execution grouping. Train client 0

@@ -103,6 +103,39 @@ def test_resume_matches_straight_run(tiny_config, tmp_path):
     assert resumed_accs == straight_accs[2:]
 
 
+def test_resume_unfolded_checkpoint_via_model_args(tiny_config, tmp_path):
+    """The ADVICE-r3 escape hatch end-to-end: a checkpoint written with
+    fold_stage1=False (pre-fold parameter structure) resumes ONLY with the
+    matching model_args; the default (folded) config rejects it with the
+    structure-mismatch error instead of failing inside jit."""
+    base = dataclasses.replace(
+        tiny_config, model_name="resnet18", worker_number=2, batch_size=8,
+        n_train=64, n_test=32,
+        dataset_args={"difficulty": 0.5, "shape": (32, 32, 3)},
+        model_args={"fold_stage1": False},
+    )
+    ckdir = str(tmp_path / "ck")
+    run_simulation(
+        dataclasses.replace(base, round=1, checkpoint_dir=ckdir,
+                            checkpoint_every=1),
+        setup_logging=False,
+    )
+    # default (folded) structure must refuse the unfolded checkpoint
+    with pytest.raises(ValueError, match="parameter structure"):
+        run_simulation(
+            dataclasses.replace(base, round=2, checkpoint_dir=ckdir,
+                                resume=True, model_args={}),
+            setup_logging=False,
+        )
+    # the matching model_args resume works
+    resumed = run_simulation(
+        dataclasses.replace(base, round=2, checkpoint_dir=ckdir,
+                            resume=True),
+        setup_logging=False,
+    )
+    assert len(resumed["history"]) == 1
+
+
 def test_resume_client_state_mismatch_raises(tiny_config, tmp_path):
     """A checkpoint whose per-client state shape disagrees with the current
     config (e.g. sign_SGD momentum=0 -> no buffers, momentum>0 -> buffers)
