@@ -236,6 +236,94 @@ def _fgn_bwd(g: int, eps: float, out_dtype, res, dy):
 _folded_group_norm.defvjp(_fgn_fwd, _fgn_bwd)
 
 
+def _gn_forward(x, scale, bias, g: int, eps: float, out_dtype):
+    """Unfolded NHWC GroupNorm forward; returns (y, mean, rstd)."""
+    b, h, w, c = x.shape
+    cpg = c // g
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, cpg)
+    mean = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)
+    mean2 = jnp.mean(jnp.square(x32), axis=(1, 2, 4), keepdims=True)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    norm = ((x32 - mean) * rstd).reshape(b, h, w, c)
+    return (norm * scale + bias).astype(out_dtype), mean, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _plain_group_norm(x, scale, bias, g: int, eps: float, out_dtype):
+    return _gn_forward(x, scale, bias, g, eps, out_dtype)[0]
+
+
+def _pgn_fwd(x, scale, bias, g, eps, out_dtype):
+    y, mean, rstd = _gn_forward(x, scale, bias, g, eps, out_dtype)
+    return y, (x, scale, bias, mean, rstd)
+
+
+def _pgn_bwd(g: int, eps: float, out_dtype, res, dy):
+    """Closed-form GN backward for the unfolded layout (same derivation
+    as :func:`_fgn_bwd`, without the tx fold)."""
+    x, scale, bias, mean, rstd = res
+    b, h, w, c = x.shape
+    cpg = c // g
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, cpg)
+    xhat = (x32 - mean) * rstd
+    dy32 = dy.astype(jnp.float32)
+    dyg = (dy32 * scale).reshape(b, h, w, g, cpg)
+    m1 = jnp.mean(dyg, axis=(1, 2, 4), keepdims=True)
+    m2 = jnp.mean(dyg * xhat, axis=(1, 2, 4), keepdims=True)
+    dx = (rstd * (dyg - m1 - xhat * m2)).reshape(b, h, w, c)
+    dscale = jnp.sum(
+        dy32 * xhat.reshape(b, h, w, c), axis=(0, 1, 2)
+    ).astype(scale.dtype)
+    dbias = jnp.sum(dy32, axis=(0, 1, 2)).astype(bias.dtype)
+    return dx.astype(x.dtype), dscale, dbias
+
+
+_plain_group_norm.defvjp(_pgn_fwd, _pgn_bwd)
+
+
+class PlainGroupNorm(nn.Module):
+    """GroupNorm with the closed-form backward (:func:`_pgn_bwd`).
+
+    Replaces ``nn.GroupNorm`` in the unfolded blocks — same parameter
+    names/shapes/init (instantiate with ``name="GroupNorm_N"`` to keep
+    flax auto-named trees identical), same one-pass E[x^2]-E[x]^2
+    statistics. Numerics: f32-exact against flax; under bf16 the affine
+    is applied in f32 and cast ONCE at the output (flax casts operands to
+    bf16 first), so bf16 outputs agree within an output ulp rather than
+    bitwise — tests/test_folded_resnet.py covers both. Exists because XLA
+    autodiff of the statistics emits separate VPU-bound stat-reduce
+    passes per GroupNorm (docs/PERFORMANCE.md round 4);
+    ``custom_backward=False`` restores autodiff of the same forward.
+    """
+
+    num_groups: int
+    dtype: jnp.dtype = jnp.bfloat16
+    epsilon: float = 1e-6
+    custom_backward: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        if c % self.num_groups:
+            # nn.GroupNorm raises this clearly at call time; keep the
+            # clear error rather than a reshape failure inside jit.
+            raise ValueError(
+                f"number of groups ({self.num_groups}) must divide the "
+                f"channel count ({c})"
+            )
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        if self.custom_backward:
+            return _plain_group_norm(
+                x, scale, bias, self.num_groups, self.epsilon, self.dtype
+            )
+        y, _, _ = _gn_forward(
+            x, scale, bias, self.num_groups, self.epsilon, self.dtype
+        )
+        return y
+
+
 class FoldedGroupNorm(nn.Module):
     """GroupNorm computed directly ON the folded layout.
 
@@ -261,6 +349,11 @@ class FoldedGroupNorm(nn.Module):
     @nn.compact
     def __call__(self, xf):
         c = xf.shape[-1] // 2
+        if c % self.num_groups:
+            raise ValueError(
+                f"number of groups ({self.num_groups}) must divide the "
+                f"channel count ({c})"
+            )
         scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
         if self.custom_backward:
@@ -318,14 +411,16 @@ class FoldedTransitionBlock(nn.Module):
             (2, 1), ((0, 1), (0, 1)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        y = nn.GroupNorm(
-            num_groups=min(32, self.features), dtype=self.dtype
+        y = PlainGroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype,
+            name="GroupNorm_0",
         )(y)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype)(y)
-        y = nn.GroupNorm(
-            num_groups=min(32, self.features), dtype=self.dtype
+        y = PlainGroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype,
+            name="GroupNorm_1",
         )(y)
         wp = self.param(
             "proj_kernel", nn.initializers.lecun_normal(),
@@ -337,8 +432,9 @@ class FoldedTransitionBlock(nn.Module):
             (2, 1), ((0, 0), (0, 0)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        residual = nn.GroupNorm(
-            num_groups=min(32, self.features), dtype=self.dtype
+        residual = PlainGroupNorm(
+            num_groups=min(32, self.features), dtype=self.dtype,
+            name="GroupNorm_2",
         )(residual)
         return nn.relu(y + residual)
 
@@ -355,19 +451,22 @@ class ResidualBlock(nn.Module):
             self.features, (3, 3), strides=(self.strides, self.strides),
             padding="SAME", use_bias=False, dtype=self.dtype,
         )(x)
-        y = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(y)
+        y = PlainGroupNorm(num_groups=min(32, self.features),
+                           dtype=self.dtype, name="GroupNorm_0")(y)
         y = nn.relu(y)
         y = nn.Conv(
             self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
         )(y)
-        y = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(y)
+        y = PlainGroupNorm(num_groups=min(32, self.features),
+                           dtype=self.dtype, name="GroupNorm_1")(y)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.features, (1, 1), strides=(self.strides, self.strides),
                 use_bias=False, dtype=self.dtype,
             )(residual)
-            residual = nn.GroupNorm(
-                num_groups=min(32, self.features), dtype=self.dtype
+            residual = PlainGroupNorm(
+                num_groups=min(32, self.features), dtype=self.dtype,
+                name="GroupNorm_2",
             )(residual)
         return nn.relu(y + residual)
 
@@ -417,8 +516,9 @@ class ResNet18(nn.Module):
         else:
             x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
                         dtype=self.dtype)(x)
-            x = nn.GroupNorm(
-                num_groups=min(32, self.width), dtype=self.dtype
+            x = PlainGroupNorm(
+                num_groups=min(32, self.width), dtype=self.dtype,
+                name="GroupNorm_0",
             )(x)
             x = nn.relu(x)
         for stage, n_blocks in enumerate(self.stage_sizes):

@@ -125,6 +125,64 @@ def test_folded_resnet_gradients_match_unfolded():
         )
 
 
+def test_plain_group_norm_matches_flax():
+    """PlainGroupNorm (closed-form backward) must match nn.GroupNorm in
+    forward AND gradients (f32, tight tolerance) — it replaces it
+    throughout the unfolded blocks under the same parameter names."""
+    import flax.linen as nn
+
+    from distributed_learning_simulator_tpu.models.resnet import (
+        PlainGroupNorm,
+    )
+
+    x = jax.random.normal(jax.random.key(0), (4, 8, 8, 64), jnp.float32)
+    y = np.asarray(jax.random.randint(jax.random.key(1), (4,), 0, 10))
+    # bf16 (production dtype): agreement within output ulps — our affine
+    # runs in f32 with ONE output cast, flax casts operands to bf16 first.
+    ours16 = PlainGroupNorm(num_groups=32, dtype=jnp.bfloat16)
+    ref16 = nn.GroupNorm(num_groups=32, dtype=jnp.bfloat16)
+    p16 = ref16.init(jax.random.key(2), x)["params"]
+    np.testing.assert_allclose(
+        np.asarray(ours16.apply({"params": p16}, x), dtype=np.float32),
+        np.asarray(ref16.apply({"params": p16}, x), dtype=np.float32),
+        rtol=0.02, atol=0.02,
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="must divide"):
+        PlainGroupNorm(num_groups=32, dtype=jnp.float32).init(
+            jax.random.key(0), jnp.zeros((1, 4, 4, 48), jnp.float32)
+        )
+    ours = PlainGroupNorm(num_groups=32, dtype=jnp.float32)
+    ref = nn.GroupNorm(num_groups=32, dtype=jnp.float32)
+    p_ours = ours.init(jax.random.key(2), x)["params"]
+    p_ref = ref.init(jax.random.key(2), x)["params"]
+    assert jax.tree_util.tree_structure(p_ours) == (
+        jax.tree_util.tree_structure(p_ref)
+    )
+    # randomize params so grads through scale/bias are non-trivial
+    p = jax.tree_util.tree_map(
+        lambda l: l + 0.3 * jax.random.normal(jax.random.key(3), l.shape),
+        p_ref,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours.apply({"params": p}, x)),
+        np.asarray(ref.apply({"params": p}, x)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    def loss(module, params, inp):
+        out = module.apply({"params": params}, inp)
+        return jnp.sum(out * out) + jnp.sum(out[..., y])
+
+    g_ours = jax.grad(lambda pp, xx: loss(ours, pp, xx), argnums=(0, 1))(p, x)
+    g_ref = jax.grad(lambda pp, xx: loss(ref, pp, xx), argnums=(0, 1))(p, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ours),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_folded_param_count_unchanged():
     """Folding changes layout only: identical total parameter count."""
     x = jnp.zeros((1, 32, 32, 3), jnp.float32)
