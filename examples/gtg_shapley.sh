@@ -1,7 +1,11 @@
 #!/bin/bash
 # GTG-Shapley Monte-Carlo contribution scoring: permutation sampling with
 # guided truncation; per-round Shapley values logged and subset metrics
-# pickled to the run's artifact dir.
+# pickled to the run's artifact dir. At large N add
+# --shapley_eval_samples 2000 (subset utilities on a test subsample) and
+# --shapley_eval_chunk 64 (amortize the client-stack read across more
+# subsets per batched call): N=1000 cnn_tpu measures 173 s/round
+# (docs/PERFORMANCE.md § Scale validation).
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name mnist --model_name lenet5 \
   --distributed_algorithm GTG_shapley_value \
