@@ -372,18 +372,21 @@ class FoldedResidualBlock(nn.Module):
 
     features: int
     dtype: jnp.dtype = jnp.bfloat16
+    gn_custom_backward: bool = True
 
     @nn.compact
     def __call__(self, xf):
         residual = xf
         y = FoldedConv3x3(self.features, dtype=self.dtype)(xf)
         y = FoldedGroupNorm(
-            num_groups=min(32, self.features), dtype=self.dtype
+            num_groups=min(32, self.features), dtype=self.dtype,
+            custom_backward=self.gn_custom_backward,
         )(y)
         y = nn.relu(y)
         y = FoldedConv3x3(self.features, dtype=self.dtype)(y)
         y = FoldedGroupNorm(
-            num_groups=min(32, self.features), dtype=self.dtype
+            num_groups=min(32, self.features), dtype=self.dtype,
+            custom_backward=self.gn_custom_backward,
         )(y)
         return nn.relu(y + residual)
 
@@ -397,6 +400,7 @@ class FoldedTransitionBlock(nn.Module):
 
     features: int
     dtype: jnp.dtype = jnp.bfloat16
+    gn_custom_backward: bool = True
 
     @nn.compact
     def __call__(self, xf):
@@ -414,6 +418,7 @@ class FoldedTransitionBlock(nn.Module):
         y = PlainGroupNorm(
             num_groups=min(32, self.features), dtype=self.dtype,
             name="GroupNorm_0",
+            custom_backward=self.gn_custom_backward,
         )(y)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
@@ -421,6 +426,7 @@ class FoldedTransitionBlock(nn.Module):
         y = PlainGroupNorm(
             num_groups=min(32, self.features), dtype=self.dtype,
             name="GroupNorm_1",
+            custom_backward=self.gn_custom_backward,
         )(y)
         wp = self.param(
             "proj_kernel", nn.initializers.lecun_normal(),
@@ -435,6 +441,7 @@ class FoldedTransitionBlock(nn.Module):
         residual = PlainGroupNorm(
             num_groups=min(32, self.features), dtype=self.dtype,
             name="GroupNorm_2",
+            custom_backward=self.gn_custom_backward,
         )(residual)
         return nn.relu(y + residual)
 
@@ -443,6 +450,7 @@ class ResidualBlock(nn.Module):
     features: int
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    gn_custom_backward: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -452,13 +460,15 @@ class ResidualBlock(nn.Module):
             padding="SAME", use_bias=False, dtype=self.dtype,
         )(x)
         y = PlainGroupNorm(num_groups=min(32, self.features),
-                           dtype=self.dtype, name="GroupNorm_0")(y)
+                           dtype=self.dtype, name="GroupNorm_0",
+                           custom_backward=self.gn_custom_backward)(y)
         y = nn.relu(y)
         y = nn.Conv(
             self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
         )(y)
         y = PlainGroupNorm(num_groups=min(32, self.features),
-                           dtype=self.dtype, name="GroupNorm_1")(y)
+                           dtype=self.dtype, name="GroupNorm_1",
+                           custom_backward=self.gn_custom_backward)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.features, (1, 1), strides=(self.strides, self.strides),
@@ -467,6 +477,7 @@ class ResidualBlock(nn.Module):
             residual = PlainGroupNorm(
                 num_groups=min(32, self.features), dtype=self.dtype,
                 name="GroupNorm_2",
+                custom_backward=self.gn_custom_backward,
             )(residual)
         return nn.relu(y + residual)
 
@@ -483,6 +494,10 @@ class ResNet18(nn.Module):
     # layout changes. Applicable when the stage is stride-1 at width 64
     # with an even spatial W — the CIFAR-style configuration.
     fold_stage1: bool = True
+    # Closed-form GroupNorm backward (custom_vjp) throughout; False
+    # restores XLA autodiff of the same forward. Escape hatch reachable
+    # via --model_args '{"gn_custom_backward": false}'.
+    gn_custom_backward: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -510,6 +525,7 @@ class ResNet18(nn.Module):
             x = FoldedGroupNorm(
                 num_groups=min(32, self.width), dtype=self.dtype,
                 name="GroupNorm_0",
+                custom_backward=self.gn_custom_backward,
             )(x)
             x = nn.relu(x)
             folded = True
@@ -519,23 +535,33 @@ class ResNet18(nn.Module):
             x = PlainGroupNorm(
                 num_groups=min(32, self.width), dtype=self.dtype,
                 name="GroupNorm_0",
+                custom_backward=self.gn_custom_backward,
             )(x)
             x = nn.relu(x)
         for stage, n_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**stage)
             if stage == 0 and folded:
                 for block in range(n_blocks):
-                    x = FoldedResidualBlock(features, dtype=self.dtype)(x)
+                    x = FoldedResidualBlock(
+                        features, dtype=self.dtype,
+                        gn_custom_backward=self.gn_custom_backward,
+                    )(x)
                 continue
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
                 if folded and block == 0:
                     # Stride-2 entry consumes the folded map directly and
                     # emits the unfolded downsampled one.
-                    x = FoldedTransitionBlock(features, dtype=self.dtype)(x)
+                    x = FoldedTransitionBlock(
+                        features, dtype=self.dtype,
+                        gn_custom_backward=self.gn_custom_backward,
+                    )(x)
                     folded = False
                 else:
-                    x = ResidualBlock(features, strides, dtype=self.dtype)(x)
+                    x = ResidualBlock(
+                        features, strides, dtype=self.dtype,
+                        gn_custom_backward=self.gn_custom_backward,
+                    )(x)
         if folded:  # single-stage configuration: unfold for the head
             b, h, wf, c2 = x.shape
             x = x.reshape(b, h, wf * 2, c2 // 2)

@@ -183,6 +183,31 @@ def test_plain_group_norm_matches_flax():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_gn_custom_backward_matches_autodiff():
+    """The closed-form GN backward vs XLA autodiff of the SAME forward,
+    through the whole folded model: gradients must agree tightly in f32
+    (gn_custom_backward=False is the escape hatch --model_args exposes)."""
+    x = np.asarray(
+        jax.random.normal(jax.random.key(8), (2, 32, 32, 3), jnp.float32)
+    )
+    y = np.asarray(jax.random.randint(jax.random.key(9), (2,), 0, 10))
+    custom = ResNet18(dtype=jnp.float32, gn_custom_backward=True)
+    auto = ResNet18(dtype=jnp.float32, gn_custom_backward=False)
+    p = custom.init(jax.random.key(0), x[:1])["params"]
+
+    def loss(model, params):
+        logits = model.apply({"params": params}, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    g_c = jax.grad(lambda pp: loss(custom, pp))(p)
+    g_a = jax.grad(lambda pp: loss(auto, pp))(p)
+    for a, b in zip(jax.tree_util.tree_leaves(g_c),
+                    jax.tree_util.tree_leaves(g_a)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_folded_param_count_unchanged():
     """Folding changes layout only: identical total parameter count."""
     x = jnp.zeros((1, 32, 32, 3), jnp.float32)
