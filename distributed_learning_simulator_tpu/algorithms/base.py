@@ -69,6 +69,20 @@ class Algorithm:
     def __init__(self, config):
         self.config = config
 
+    def check_cohort(self, n_clients: int) -> None:
+        """Validate the ACTUAL client count before any training runs.
+
+        Called with the true ``n_clients`` (which a caller-supplied
+        ``ClientData`` may make different from ``config.worker_number``)
+        from every execution path's build step: the simulator calls it
+        right before building the round fn on the vmap path (so every
+        algorithm is covered regardless of its ``make_round_fn``
+        inheritance; ``FedAvg.make_round_fn`` also calls it for direct
+        library users), and the threaded runner before its pool spawns. The
+        constructor can only see ``worker_number``, so count-dependent
+        feasibility checks (exact Shapley's 2^N bound, GTG's permutation
+        cap) live here and merely warn at construction."""
+
     @property
     def materializes_client_stack(self) -> bool:
         """Whether the round program holds the full [n_clients, params]
@@ -92,6 +106,14 @@ class Algorithm:
         config.bucket_client_work); pass None when the client axis is
         sharded over a mesh (the static regrouping would fight the
         sharding layout) or when counts aren't known up front.
+        ``client_sizes`` is captured at BUILD time into the static slice
+        plan, while aggregation weights use the per-round ``sizes``
+        operand — the two must describe the same clients. Mutating the
+        client data (e.g. ``ClientData.override_client``) after building
+        the round fn leaves a stale plan that silently truncates any
+        client grown past its group's step budget: inject data BEFORE
+        construction, as ``run_simulation`` and ``simulator_heterogeneous``
+        do (ADVICE r4).
 
         ``client_state`` is whatever per-client state persists across rounds
         (optimizer/momentum buffers) as a client-stacked pytree; ``aux`` is a

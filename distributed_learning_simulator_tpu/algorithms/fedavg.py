@@ -148,6 +148,12 @@ class FedAvg(Algorithm):
                       preprocess=None, client_sizes=None):
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 
+        # Count-dependent feasibility (exact Shapley's 2^N bound, GTG's
+        # permutation cap) fires here against the TRUE client count —
+        # before any training — rather than in the constructor, which only
+        # sees config.worker_number (a caller-supplied ClientData may
+        # legitimately differ; ADVICE r4).
+        self.check_cohort(n_clients)
         cfg = self.config
         compute_dtype = None
         if getattr(cfg, "local_compute_dtype", "float32") == "bfloat16":
@@ -192,6 +198,21 @@ class FedAvg(Algorithm):
         # client's samples occupy its first slots, always inside the
         # group's slice — and empty clients are skipped outright (their
         # aggregation weight is 0 and their metrics are 0 either way).
+        #
+        # Optimizer-step-count caveat (ADVICE r4): a small client's skipped
+        # masked-slot steps are real optimizer steps in the unscheduled
+        # path — zero-grad steps still apply weight decay, and with
+        # reset_client_optimizer=False they decay momentum. So with
+        # weight_decay > 0 or persistent client optimizers, scheduling ON
+        # vs OFF differs beyond batch-composition reshuffle noise: each
+        # client now takes exactly the steps its own data needs. That is
+        # the REFERENCE's semantics — each of its workers trains on its
+        # own dataset (workers/worker.py:22 delegates to a per-worker
+        # Trainer over that worker's loader), so a small client takes
+        # fewer steps per epoch there too; the padded-slot steps are
+        # this simulator's packing artifact, not behavior to preserve.
+        # Runs that need bit-comparability with the unscheduled path under
+        # those settings should set bucket_client_work=False.
         bucket_sizes = None
         if (
             client_sizes is not None

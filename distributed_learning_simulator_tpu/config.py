@@ -128,7 +128,14 @@ class ExperimentConfig:
     gtg_eps: float = 1e-3
     gtg_last_k: int = 10
     gtg_converge_criteria: float = 0.05
-    gtg_max_permutations: int = 500
+    # Cap on GTG permutations per round. None = auto ``max(500, 2N)`` at
+    # the actual client count N: one GTG sampling iteration draws N
+    # permutations (one starting with each worker,
+    # GTG_shapley_value_server.py:42-49) and the convergence test needs
+    # more than ``max(30, N)`` marginal records, so any cap below 2N can
+    # never run a converged estimate — an explicit cap below N is
+    # rejected at round-fn build (GTGShapley.check_cohort).
+    gtg_max_permutations: int | None = None
     # Cap on test samples used for SUBSET-utility evaluations (the round's
     # reported test metric always uses the full set). None = full set (the
     # reference's behavior). At large N the GTG round is compute-bound on
@@ -177,7 +184,13 @@ class ExperimentConfig:
     # of every client scanning the padded global maximum. Same per-epoch
     # sample coverage (each real sample still visited exactly once per
     # epoch); batch composition — hence the exact SGD trajectory — differs
-    # the way any reshuffle does. Skipped automatically when it cannot help
+    # the way any reshuffle does. Per-client OPTIMIZER STEP COUNTS also
+    # change: skipped masked-slot steps were real (zero-grad) steps, so
+    # with weight_decay > 0 or reset_client_optimizer=False results differ
+    # beyond reshuffle noise — matching the reference's per-worker loops
+    # (each worker steps only over its own data); set False for
+    # bit-comparability with the unscheduled path under those settings
+    # (see algorithms/fedavg.py). Skipped automatically when it cannot help
     # (uniform shards) or cannot apply (mesh/multihost sharding, client
     # sampling, materializing algorithms, unchunked rounds).
     bucket_client_work: bool = True
@@ -314,6 +327,14 @@ class ExperimentConfig:
             raise ValueError("shapley_eval_samples must be >= 1 or None")
         if self.shapley_eval_chunk < 1:
             raise ValueError("shapley_eval_chunk must be >= 1")
+        if (
+            self.gtg_max_permutations is not None
+            and self.gtg_max_permutations < 1
+        ):
+            raise ValueError(
+                "gtg_max_permutations must be >= 1 or None (= auto "
+                "max(500, 2N))"
+            )
         if self.lr_schedule.lower() not in ("constant", "cosine", "step"):
             raise ValueError(
                 f"unknown lr_schedule {self.lr_schedule!r}; known: "
@@ -387,7 +408,7 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
             )
         elif f.name in ("n_train", "n_test", "mesh_devices", "num_processes",
                         "process_id", "lr_schedule_rounds",
-                        "shapley_eval_samples"):
+                        "shapley_eval_samples", "gtg_max_permutations"):
             parser.add_argument(arg, type=int, default=None)
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
                         "profile_dir", "client_chunk_size", "max_shard_size",

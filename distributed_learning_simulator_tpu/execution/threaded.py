@@ -748,6 +748,12 @@ def run_threaded_simulation(
             )
 
             shapley = get_algorithm(algo_name, config)
+            # Count-dependent feasibility (exact Shapley's 2^N bound,
+            # GTG's permutation cap) against the TRUE client count,
+            # BEFORE any threads spawn (ADVICE r3 up-front-failure rule,
+            # relocated from the constructor which only sees
+            # worker_number — ADVICE r4).
+            shapley.check_cohort(client_data.n_clients)
             shapley.prepare(model.apply, make_eval_fn(model.apply))
             server = ThreadedShapleyServer(
                 config, evaluate, eval_batches, params, shapley,

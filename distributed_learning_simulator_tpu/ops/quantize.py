@@ -33,29 +33,104 @@ class QuantizedTensor(NamedTuple):
     zero_point: jax.Array  # scalar, in the quantized domain
 
 
+def _minmax(x):
+    """(min, max) of ``x`` in ONE pass over the data, in x's own dtype.
+
+    Separate ``jnp.min``/``jnp.max`` calls lower to two XLA reduces — two
+    full reads of the tensor — and the QAT path runs them on every param
+    leaf of every client at every optimizer step (measured 563 ms/round =
+    21% of the flagship fed_quant round, 332 GB of pure range-pass
+    traffic). A variadic ``lax.reduce`` computes both extrema in one read.
+    Reducing in the input dtype is exact (min/max select, they never
+    round), so the resulting affine params are bit-identical to the old
+    upcast-then-reduce formulation.
+    """
+    if x.size == 0:
+        # jnp.min/jnp.max raised loudly here; the init-value reduce would
+        # silently return (inf, -inf) and poison scale/zero_point.
+        raise ValueError("cannot quantize a zero-size tensor")
+    return jax.lax.reduce(
+        (x, x),
+        (jnp.asarray(jnp.inf, x.dtype), jnp.asarray(-jnp.inf, x.dtype)),
+        lambda a, b: (jnp.minimum(a[0], b[0]), jnp.maximum(a[1], b[1])),
+        tuple(range(x.ndim)),
+    )
+
+
 def _affine_params(x, levels: int):
-    xmin = jnp.min(x)
-    xmax = jnp.max(x)
+    if x.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16, jnp.float64):
+        # The in-dtype range pass needs +-inf init values: ints/bools have
+        # none (OverflowError) and fp8 e4m3fn converts inf to NaN. Those
+        # inputs gain nothing from the in-dtype read anyway — upcast.
+        x = x.astype(jnp.float32)
+    xmin, xmax = _minmax(x)
+    xmin = xmin.astype(jnp.float32)
+    xmax = xmax.astype(jnp.float32)
     span = xmax - xmin
     scale = jnp.where(span > 0, span / (levels - 1), 1.0)
     zero_point = -xmin / scale
     return scale, zero_point
 
 
+def hash_mix(u, salt):
+    """Two-round multiplicative hash of uint32 ``u`` mixed with ``salt``.
+
+    THE one copy of the dither-hash mixing (statistical quality is
+    certified by the SR/quantize unbiasedness tests): shared by the
+    engine's bf16 stochastic rounding (parallel/engine.py ``_sr_to_bf16``)
+    and the quantized-payload stochastic rounding below. Pure fused
+    elementwise ALU — no PRNG tensor is generated or moved.
+    """
+    h = u * jnp.uint32(2654435761) ^ (u >> 13) ^ salt
+    return h * jnp.uint32(2246822519) ^ (h >> 16)
+
+
+def _salt_from_key(key) -> jax.Array:
+    """Fold a JAX PRNG key (typed or raw uint32 data) into a uint32 salt."""
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        kd = jax.random.key_data(key)
+    else:
+        kd = key
+    kd = kd.reshape(-1).astype(jnp.uint32)
+    return kd[0] * jnp.uint32(0x9E3779B9) ^ kd[-1]
+
+
+def _dither_u01(x32, salt) -> jax.Array:
+    """Uniform [0, 1) dither from a multiplicative hash of the value bits
+    mixed with ``salt`` — the same pure-ALU mechanism as the engine's
+    bf16 stochastic rounding (parallel/engine.py ``_sr_to_bf16``).
+
+    Exists because a real counter PRNG is a measured round cost here: with
+    ``jax.random.bernoulli`` the threefry bit generation fused into the
+    uplink's aggregation partials and dragged them from ~900 GB/s to
+    78-92 GB/s (~0.4 s/round on the flagship fed_quant config — the entire
+    gap to plain fed). The hash is free: no random tensor is generated or
+    moved, and decorrelation across clients comes from the per-client
+    salt (the same load-bearing property as hash-dither SR —
+    docs/PERFORMANCE.md round 2).
+    """
+    u = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    h = hash_mix(u, salt)
+    return (h >> 8).astype(jnp.float32) * jnp.float32(2.0**-24)
+
+
 def stochastic_quantize(x, levels: int, key) -> QuantizedTensor:
     """Quantize ``x`` to ``levels`` levels with stochastic rounding.
 
-    Unbiased: ``E[dequantize(stochastic_quantize(x))] = x``. Parity with the
-    external ``stochastic_quantization`` used at fed_quant_server.py:37-39
-    (256 levels = 8-bit).
+    Unbiased: ``E[dequantize(stochastic_quantize(x))] = x`` (the round-up
+    indicator is ``floor(n + u) - floor(n)`` with ``u`` uniform [0, 1), so
+    ``P[up] = frac(n)``; tests/test_quantize.py averages over keys).
+    Parity with the external ``stochastic_quantization`` used at
+    fed_quant_server.py:37-39 (256 levels = 8-bit). The randomness is a
+    hash dither keyed by ``key`` (see :func:`_dither_u01` for why a
+    counter PRNG is disqualified here).
     """
-    x = jnp.asarray(x, dtype=jnp.float32)
+    x = jnp.asarray(x)  # array-likes in; range pass stays in x's own dtype
     scale, zero_point = _affine_params(x, levels)
+    x = jnp.asarray(x, dtype=jnp.float32)
     normalized = x / scale + zero_point
-    floor = jnp.floor(normalized)
-    frac = normalized - floor
-    up = jax.random.bernoulli(key, frac.astype(jnp.float32))
-    codes = jnp.clip(floor + up.astype(jnp.float32), 0, levels - 1)
+    dither = _dither_u01(normalized, _salt_from_key(key))
+    codes = jnp.clip(jnp.floor(normalized + dither), 0, levels - 1)
     return QuantizedTensor(codes=codes, scale=scale, zero_point=zero_point)
 
 
@@ -86,12 +161,26 @@ def fake_quant(x, levels: int):
     This is the QAT primitive replacing PyTorch's fake-quant observers
     (reference quant_model.py:9-11); applying it to params inside the loss
     trains a model robust to ``levels``-level parameter quantization.
+
+    The round-trip arithmetic runs in f32 (bf16 integer codes near
+    ``levels-1`` have a 2-ulp spacing and would mis-round), but the result
+    is cast back to ``x.dtype``: the transformed params feed bf16 MXU
+    convs anyway, and keeping the output in the storage dtype lets the
+    whole transform fuse into the step instead of materializing an f32
+    copy of every client's parameter tree.
     """
-    x = jnp.asarray(x, dtype=jnp.float32)
+    x = jnp.asarray(x)  # array-likes in; range pass stays in x's own dtype
+    in_dtype = x.dtype
+    # Range pass BEFORE the f32 upcast: the reduce reads the tensor in its
+    # storage dtype (half the bytes under bf16 state) and the upcast stays
+    # a fusible elementwise step instead of a materialized copy feeding
+    # two reduces. bf16 -> f32 is exact, so the affine params match the
+    # upcast-then-reduce formulation bitwise.
     scale, zero_point = _affine_params(jax.lax.stop_gradient(x), levels)
+    x = jnp.asarray(x, dtype=jnp.float32)
     codes = jnp.clip(jnp.round(x / scale + zero_point), 0, levels - 1)
     dq = (codes - zero_point) * scale
-    return x + jax.lax.stop_gradient(dq - x)
+    return (x + jax.lax.stop_gradient(dq - x)).astype(in_dtype)
 
 
 def fake_quant_tree(tree, levels: int):

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from distributed_learning_simulator_tpu.ops.quantize import hash_mix
+
 
 def make_optimizer(name: str, learning_rate: float, momentum: float = 0.0,
                    weight_decay: float = 0.0):
@@ -104,8 +106,7 @@ def _sr_to_bf16(x32, salt):
     (bf16 array, advanced salt).
     """
     u = jax.lax.bitcast_convert_type(x32, jnp.uint32)
-    h = u * jnp.uint32(2654435761) ^ (u >> 13) ^ salt
-    h = h * jnp.uint32(2246822519) ^ (h >> 16)
+    h = hash_mix(u, salt)  # ops/quantize.py: the one copy of the mixing
     u = (u + (h & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000)
     rounded = jax.lax.bitcast_convert_type(u, jnp.float32)
     return rounded.astype(jnp.bfloat16), salt + jnp.uint32(0x9E3779B9)

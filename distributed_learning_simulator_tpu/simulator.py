@@ -390,6 +390,11 @@ def run_simulation(
     _sharded = config.multihost or (
         config.mesh_devices is not None and config.mesh_devices > 1
     )
+    # Count-dependent feasibility (exact Shapley's 2^N bound, GTG's
+    # permutation cap) against the TRUE client count, for every algorithm
+    # regardless of its make_round_fn inheritance (the threaded runner
+    # makes the mirror call before its pool spawns).
+    algorithm.check_cohort(n_clients)
     round_fn = algorithm.make_round_fn(
         model.apply, optimizer, n_clients, preprocess=preprocess,
         client_sizes=None if _sharded else client_data.sizes,

@@ -30,6 +30,21 @@ def iter_device_ops(trace_dir: str):
     ``raw_bytes_accessed``), with parent ``while``/``jit(...)`` frames
     excluded — those wrap their children's time and would double count.
     Missing/empty trace dirs yield nothing rather than raising.
+
+    Two assumptions callers must hold (ADVICE r4):
+
+    * ``trace_dir`` must hold exactly ONE profiling session. Every
+      ``*.trace.json.gz`` under the directory is summed, so a reused
+      directory accumulates stale sessions into the totals. bench.py's
+      proxy uses a fresh ``TemporaryDirectory`` per run; the profiling
+      scripts ``rm -rf`` their target first.
+    * Parent-frame exclusion is by the ``while``/``jit(`` name prefixes —
+      the two wrapper frames XLA emits for these programs (whole-program
+      jit frame, round/epoch/step ``while`` loops). A program whose
+      byte-carrying ops sit under differently-named wrapper frames that
+      also carry ``raw_bytes_accessed`` would double count; if a new
+      wrapper family appears, extend the prefix list and re-baseline the
+      proxy totals.
     """
     paths = glob.glob(
         os.path.join(trace_dir, "plugins", "profile", "*",

@@ -1,0 +1,77 @@
+"""Aggregate a saved device trace by program category (stage attribution).
+
+Usage: python scripts/trace_categories.py <trace_dir> [top_n]
+
+Buckets ops by shape signatures in ``long_name`` (ResNet-18 stage maps at
+the flagship chunk-40 config), so a round's device time reads as a stage
+budget instead of 3000 instance rows. Pure-CPU parse of an existing trace.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_learning_simulator_tpu.utils.tracing import iter_device_ops
+
+RULES = [
+    ("s4_wgrad", r"3,3,512,512.*fusion\(|fusion.*= f32\[3,3,512,512\]"),
+    ("s3_wgrad", r"= f32\[3,3,256,256\]"),
+    ("s2_wgrad", r"= f32\[3,3,128,128\]"),
+    ("s1_wgrad", r"= f32\[3,3,128,40,128\]|= f32\[3,4,3,40,128\]|= f32\[3,2,128,40,"),
+    ("stage4", r"4,4,512|2,2,512"),
+    ("stage3", r"8,8,256"),
+    ("stage2", r"16,16,128"),
+    ("stage1f", r"32,16,128|3,3,128,40,128|3,4,3,40,128"),
+    ("dense/head", r"512,10|,10\]"),
+    ("decode", r"u8\[|s32\["),
+]
+
+
+def categorize(long_name: str) -> str:
+    for name, pat in RULES:
+        if re.search(pat, long_name):
+            return name
+    return "other"
+
+
+def main():
+    trace_dir = sys.argv[1]
+    top = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+    cats = defaultdict(lambda: [0.0, 0.0, 0])
+    ops = defaultdict(lambda: [0.0, 0.0, 0])
+    total = 0.0
+    for ev in iter_device_ops(trace_dir):
+        args = ev.get("args") or {}
+        ln = args.get("long_name", "")
+        dur = float(ev.get("dur", 0.0))
+        byt = float(args.get("raw_bytes_accessed", 0) or 0)
+        cat = categorize(ln)
+        for store in (cats[cat], ops[(cat, ev.get("name", "?").split(".")[0], ln[:100])]):
+            store[0] += dur
+            store[1] += byt
+            store[2] += 1
+        total += dur
+    print(f"total device op time: {total / 1e3:.1f} ms")
+    print(f"{'category':12s} {'ms':>9s} {'GB':>9s} {'GB/s':>7s} {'n':>6s}")
+    for cat, (dur, byt, cnt) in sorted(cats.items(), key=lambda kv: -kv[1][0]):
+        gbps = (byt / 2**30) / (dur / 1e6) if dur else 0.0
+        print(f"{cat:12s} {dur / 1e3:9.1f} {byt / 2**30:9.2f} {gbps:7.0f} {cnt:6d}")
+    for want in sys.argv[3:]:
+        print(f"\n--- top ops in {want} ---")
+        rows = sorted(
+            ((k, v) for k, v in ops.items() if k[0] == want),
+            key=lambda kv: -kv[1][0],
+        )[:top]
+        for (cat, fam, ln), (dur, byt, cnt) in rows:
+            gbps = (byt / 2**30) / (dur / 1e6) if dur else 0.0
+            print(f"{dur / 1e3:8.1f}ms {byt / 2**30:7.2f}GB {gbps:5.0f}GB/s "
+                  f"x{cnt:<4d} {fam} {ln}")
+
+
+if __name__ == "__main__":
+    main()

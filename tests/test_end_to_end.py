@@ -331,6 +331,11 @@ def test_bf16_requires_reset_optimizer(tiny_config):
 
 
 def test_bf16_rejected_for_sign_sgd(tiny_config):
+    # A bf16 shared-tree mode was built and measured in round 5: device
+    # time was IDENTICAL to f32 (2740 vs 2678 ms at flagship scale — the
+    # model's activations/convs are bf16 either way and the f32 tensors in
+    # the trace are XLA materialization choices, not the params tree), so
+    # the mode was removed rather than shipped as a dead knob.
     with pytest.raises(ValueError, match="local_compute_dtype"):
         _run(tiny_config, distributed_algorithm="sign_SGD",
              local_compute_dtype="bfloat16")

@@ -85,10 +85,15 @@ def test_exact_refuses_large_n(tiny_config):
     )
     from distributed_learning_simulator_tpu.algorithms.base import RoundContext
 
-    # Up-front: the constructor refuses before any training could run.
+    # Up-front: the build-time check refuses against the TRUE client count
+    # before any training could run. The constructor merely warns — a
+    # caller-supplied ClientData may have fewer clients than worker_number
+    # (ADVICE r4), so worker_number=17 with 12 actual clients must build.
     tiny_config.worker_number = 17
+    algo = MultiRoundShapley(tiny_config)  # warns, does not raise
+    algo.check_cohort(12)  # override cohort within bounds: allowed
     with pytest.raises(ValueError, match="2\\^N"):
-        MultiRoundShapley(tiny_config)
+        algo.check_cohort(17)
     # Backstop: a round whose actual client count exceeds 16 (heterogeneous
     # client_data overrides bypass worker_number) still refuses in post_round.
     tiny_config.worker_number = 4
@@ -100,6 +105,55 @@ def test_exact_refuses_large_n(tiny_config):
     )
     with pytest.raises(ValueError, match="2\\^N"):
         algo.post_round(ctx)
+
+
+def test_exact_refuses_large_n_at_round_fn_build(tiny_config):
+    """The vmap path's make_round_fn carries the check: worker_number > 16
+    with a matching client count fails at build time, before training."""
+    import dataclasses
+
+    import optax
+
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        MultiRoundShapley,
+    )
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="multiround_shapley_value",
+        worker_number=17,
+    )
+    algo = MultiRoundShapley(cfg)
+    with pytest.raises(ValueError, match="2\\^N"):
+        algo.make_round_fn(lambda p, x: x, optax.sgd(0.1), 17)
+
+
+def test_gtg_cap_below_n_refused(tiny_config):
+    """An explicit gtg_max_permutations below the client count can never be
+    honored (one sampling iteration draws N permutations) nor converge
+    (needs > max(30, N) records): refuse at build time (VERDICT r4 weak #2
+    — previously the cap was silently overrun and convergence silently
+    unreachable)."""
+    from distributed_learning_simulator_tpu.algorithms.shapley import GTGShapley
+
+    tiny_config.gtg_max_permutations = 3
+    algo = GTGShapley(tiny_config)  # constructor warns only
+    with pytest.raises(ValueError, match="gtg_max_permutations"):
+        algo.check_cohort(tiny_config.worker_number)
+    # A cap >= N passes the build check.
+    tiny_config.gtg_max_permutations = 500
+    GTGShapley(tiny_config).check_cohort(tiny_config.worker_number)
+
+
+def test_gtg_default_cap_is_convergence_capable(tiny_config):
+    """Unset cap resolves to max(500, 2N): at N=1000 two sampling
+    iterations fit, so the > max(30, N) record requirement is reachable."""
+    from distributed_learning_simulator_tpu.algorithms.shapley import GTGShapley
+
+    tiny_config.gtg_max_permutations = None
+    algo = GTGShapley(tiny_config)
+    algo.check_cohort(1000)  # auto cap never refuses
+    assert algo._effective_cap(4) == 500
+    assert algo._effective_cap(1000) == 2000
 
 
 def test_materializing_stack_feasibility_guard(tiny_config):
