@@ -203,6 +203,22 @@ def main():
             "trace_rounds": 3,
         }
 
+    # Same proxy for the flagship ResNet program (VERDICT r4 weak #4): all
+    # the round-4 perf work (folded stem, GN custom vjp) lives in this
+    # program, and its wall-clock signal is only +-0.2% — a lost fusion
+    # costing <2% would be invisible without the byte/op totals.
+    if run_proxy and run_flagship:
+        with tempfile.TemporaryDirectory() as td:
+            pf_config = dataclasses.replace(f_config, round=3, profile_dir=td)
+            _run(pf_config, dataset=dataset, client_data=client_data)
+            stats = parse_device_trace(td)
+        record["proxy_flagship"] = {
+            "traced_bytes_gb": round(stats["bytes_gb"], 3),
+            "traced_device_ms": round(stats["device_ms"], 1),
+            "traced_op_count": stats["op_count"],
+            "trace_rounds": 3,
+        }
+
     print(json.dumps(record))
 
 

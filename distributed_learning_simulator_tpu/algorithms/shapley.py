@@ -119,8 +119,10 @@ class _SubsetEvaluator:
     eval-batch activations live at once.
     """
 
-    def __init__(self, eval_fn, chunk: int = _EVAL_CHUNK):
+    def __init__(self, eval_fn, chunk: int = _EVAL_CHUNK,
+                 eval_dtype: str = "float32"):
         self._chunk = int(chunk)
+        self._eval_dtype = jnp.dtype(eval_dtype)
 
         # eval_fn(params, xb, yb, mb) -> {'loss','accuracy'}
         def eval_one(client_params, sizes, mask, prev_global, xb, yb, mb):
@@ -130,6 +132,22 @@ class _SubsetEvaluator:
         self._eval_chunk = jax.jit(
             jax.vmap(eval_one, in_axes=(None, None, 0, None, None, None, None))
         )
+
+    def prepare_stack(self, client_params):
+        """Cast the [n_clients, ...] stack to the evaluator read dtype ONCE
+        per round (config.shapley_eval_dtype). Each batched call re-reads
+        the whole stack for its subset weighted means — the dominant HBM
+        traffic of a large-N GTG round — so a bf16 stack halves it; the
+        tensordot still accumulates f32 (ops/aggregate.subset_weighted_mean)
+        and the subset model handed to eval is f32-ranged."""
+        if self._eval_dtype == jnp.float32:
+            return client_params
+        cast = jax.tree_util.tree_map(
+            lambda a: a.astype(self._eval_dtype), client_params
+        )
+        # Materialize now: the cast must happen once, not get re-fused into
+        # every downstream evaluator call by lazy dispatch.
+        return jax.block_until_ready(cast)
 
     def __call__(self, client_params, sizes, masks, prev_global, eval_batches):
         """masks: [M, n] numpy 0/1. Returns [M] numpy accuracies.
@@ -223,6 +241,7 @@ class MultiRoundShapley(FedAvg):
         self._evaluator = _SubsetEvaluator(
             eval_fn,
             chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
+            eval_dtype=getattr(self.config, "shapley_eval_dtype", "float32"),
         )
 
     def post_round(self, ctx: RoundContext) -> dict:
@@ -255,7 +274,8 @@ class MultiRoundShapley(FedAvg):
 
         masks = subset_masks_all(n, include_empty=True)
         utilities_arr = self._evaluator(
-            ctx.aux["client_params"], ctx.sizes, masks,
+            self._evaluator.prepare_stack(ctx.aux["client_params"]),
+            ctx.sizes, masks,
             ctx.prev_global_params,
             cap_eval_batches(
                 ctx.eval_batches,
@@ -368,6 +388,7 @@ class GTGShapley(FedAvg):
         self._evaluator = _SubsetEvaluator(
             eval_fn,
             chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
+            eval_dtype=getattr(self.config, "shapley_eval_dtype", "float32"),
         )
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
@@ -415,7 +436,7 @@ class GTGShapley(FedAvg):
             logger.info("round %d: truncated, shapley values all 0", round_idx)
             return {"shapley_values": sv, "gtg_permutations": 0}
 
-        client_params = ctx.aux["client_params"]
+        client_params = self._evaluator.prepare_stack(ctx.aux["client_params"])
         memo: dict[frozenset, float] = {}
         eval_batches = cap_eval_batches(
             ctx.eval_batches,

@@ -150,6 +150,15 @@ class ExperimentConfig:
     # ceiling is activation memory (chunk models x eval-batch activations
     # resident at once).
     shapley_eval_chunk: int = 16
+    # Dtype the subset evaluator reads the client-params stack in.
+    # "bfloat16" (default) halves the per-call stack read — the dominant
+    # HBM traffic of a large-N GTG round — while the subset weighted mean
+    # still ACCUMULATES in f32 (tensordot preferred_element_type, the
+    # MXU's native bf16-in/f32-out mode) and the produced subset model is
+    # f32. Utilities feed an argmax accuracy, so the measured SV
+    # perturbation vs "float32" is below Monte-Carlo noise
+    # (tests/test_shapley.py::test_shapley_eval_dtype_agreement).
+    shapley_eval_dtype: str = "bfloat16"
 
     # --- execution ----------------------------------------------------------
     # "vmap": the fast path — one jitted round program over the client axis.
@@ -327,6 +336,11 @@ class ExperimentConfig:
             raise ValueError("shapley_eval_samples must be >= 1 or None")
         if self.shapley_eval_chunk < 1:
             raise ValueError("shapley_eval_chunk must be >= 1")
+        if self.shapley_eval_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "shapley_eval_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.shapley_eval_dtype!r}"
+            )
         if (
             self.gtg_max_permutations is not None
             and self.gtg_max_permutations < 1

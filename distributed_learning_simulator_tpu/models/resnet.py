@@ -23,6 +23,19 @@ unfolded model, and GroupNorm statistics are computed on the unfolded
 VIEW (a fused reshape). Measured fwd+bwd per conv at chunk 40 x batch 25:
 88 -> 10.6 ms isolated (scripts/exp_folded_conv.py); whole-round effect in
 docs/PERFORMANCE.md.
+
+Round-5 negative results (kept so nobody re-tries them): (1) re-orienting
+the folded stage HWNC (batch second-minor, so the standard layout matches
+the conv backend's preferred {3,0,2,1}) measured 3.7x faster on an
+ISOLATED stage-1 block chain (scripts/exp_stage1_layout.py) but made the
+real sign_SGD round 7% SLOWER (2.72 -> 2.91 s) while leaving the bf16
+fed/fed_quant rounds flat — in context the round's other consumers
+re-introduce relayouts elsewhere. (2) `lax.optimization_barrier` between
+conv outputs and the GroupNorm f32 convert (to stop XLA writing conv
+outputs f32 via `convolution_convert_fusion` epilogues and re-reading
+them at 2x bytes in the wgrad fusions) costs more fusion than it saves:
+2.72 -> 3.17 s. Only in-context measurement is valid evidence here (the
+round-3 tap-einsum lesson, re-learned twice).
 """
 
 from __future__ import annotations
@@ -168,22 +181,40 @@ class FoldedConv3x3(nn.Module):
 
 
 def _fgn_forward(xf, scale, bias, g: int, eps: float, out_dtype):
-    """Folded-layout GroupNorm forward; returns (y, mean, rstd)."""
+    """Folded-layout GroupNorm forward; returns (y, mean, rstd).
+
+    Coefficient form (round 5): the normalize is ``y = x*a + b`` with
+    per-(sample, tx, group) f32 coefficients folded from
+    (mean, rstd, scale, bias) — so the only big-tensor consumers are ONE
+    inline-convert stats reduce and ONE bf16-in/bf16-out elementwise
+    pass. The earlier ``((x - mean) * rstd) * scale + bias`` form made
+    XLA materialize a relayouted f32 copy of every stage-1 GN input
+    (resnet.py:175 in the r4 HLO): the copy itself cost ~0.6 ms/use and
+    the conv weight-grad fusions then re-read activations at f32 (2x)
+    bytes — together ~20% of the sign_SGD round (measured, HLO-verified:
+    the copies' consumers were the transpose(jvp) conv wgrad fusions).
+    """
     b, h, wf, c2 = xf.shape
     c = c2 // 2
     cpg = c // g
-    x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
+    x6 = xf.reshape(b, h, wf, 2, g, cpg)
+    x32 = x6.astype(jnp.float32)
     # One-pass statistics (E[x^2] - E[x]^2, flax's use_fast_variance):
     # the two-pass (x - mean)^2 form reads the activations twice and
     # measurably halves this fusion's effective bandwidth. (An
     # indicator-matrix matmul formulation of the group reduction was
     # also tried — identical round time, so the simpler form stays.)
-    mean = jnp.mean(x, axis=(1, 2, 3, 5), keepdims=True)
-    mean2 = jnp.mean(jnp.square(x), axis=(1, 2, 3, 5), keepdims=True)
+    mean = jnp.mean(x32, axis=(1, 2, 3, 5), keepdims=True)
+    mean2 = jnp.mean(jnp.square(x32), axis=(1, 2, 3, 5), keepdims=True)
     var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
     rstd = jax.lax.rsqrt(var + eps)
-    norm = ((x - mean) * rstd).reshape(b, h, wf, c2)
-    y = (norm * jnp.tile(scale, 2) + jnp.tile(bias, 2)).astype(out_dtype)
+    scale2 = jnp.tile(scale, 2).reshape(2, g, cpg)
+    bias2 = jnp.tile(bias, 2).reshape(2, g, cpg)
+    a = rstd * scale2          # [b, 1, 1, 2, g, cpg] — b x 2c floats
+    # Subtract-first, then one multiply: folding mean into the additive
+    # coefficient (y = x*a + (bias - mean*a)) cancels catastrophically
+    # when |x - mean| << |x| (measured: 1% stem-wgrad error at f32).
+    y = ((x6 - mean) * a + bias2).astype(out_dtype).reshape(b, h, wf, c2)
     return y, mean, rstd
 
 
@@ -215,38 +246,59 @@ def _fgn_bwd(g: int, eps: float, out_dtype, res, dy):
     b, h, wf, c2 = xf.shape
     c = c2 // 2
     cpg = c // g
-    x = xf.astype(jnp.float32).reshape(b, h, wf, 2, g, cpg)
-    xhat = (x - mean) * rstd
-    dy32 = dy.astype(jnp.float32)
-    dyg = (dy32 * jnp.tile(scale, 2)).reshape(b, h, wf, 2, g, cpg)
+    x6 = xf.reshape(b, h, wf, 2, g, cpg)
+    dy6 = dy.reshape(b, h, wf, 2, g, cpg)
+    x32 = x6.astype(jnp.float32)
+    dy32 = dy6.astype(jnp.float32)
+    scale2 = jnp.tile(scale, 2).reshape(2, g, cpg)
+    xhat = (x32 - mean) * rstd
+    dyg = dy32 * scale2
     m1 = jnp.mean(dyg, axis=(1, 2, 3, 5), keepdims=True)
     m2 = jnp.mean(dyg * xhat, axis=(1, 2, 3, 5), keepdims=True)
-    dx = (rstd * (dyg - m1 - xhat * m2)).reshape(b, h, wf, c2)
-    dyx = (dy32.reshape(b, h, wf, 2, g, cpg) * xhat).reshape(b, h, wf, c2)
-    # Per-channel param grads: both tx placements of channel c accumulate.
-    # Cotangent dtypes must match the incoming params' dtypes (bf16 when
-    # the engine runs local_compute_dtype=bfloat16).
-    dscale = jnp.sum(dyx, axis=(0, 1, 2))
-    dscale = (dscale[:c] + dscale[c:]).astype(scale.dtype)
-    dbias = jnp.sum(dy32, axis=(0, 1, 2))
-    dbias = (dbias[:c] + dbias[c:]).astype(bias.dtype)
-    return dx.astype(xf.dtype), dscale, dbias
+    # The dx pass re-reads dy6/x6 directly (xhat recomputed in-register
+    # from the bf16 x6) with per-(sample, group) f32 coefficients — no
+    # materialized f32 xhat/dyg shared with the reduces (see
+    # _fgn_forward's rationale). Same subtract-first numerics as the old
+    # form; only the read dtype of the big tensors changed.
+    dx = ((dyg - m1 - xhat * m2) * rstd).astype(xf.dtype)
+    dx = dx.reshape(b, h, wf, c2)
+    # Per-channel param grads: both tx placements of channel c accumulate
+    # (sum over the tx axis of the [g, cpg] reduce). Cotangent dtypes must
+    # match the incoming params' dtypes (bf16 when the engine runs
+    # local_compute_dtype=bfloat16).
+    dscale = jnp.sum(dy32 * xhat, axis=(0, 1, 2, 3))
+    dscale = dscale.reshape(c).astype(scale.dtype)
+    dbias = jnp.sum(dy32, axis=(0, 1, 2, 3)).reshape(c).astype(bias.dtype)
+    return dx, dscale, dbias
 
 
 _folded_group_norm.defvjp(_fgn_fwd, _fgn_bwd)
 
 
 def _gn_forward(x, scale, bias, g: int, eps: float, out_dtype):
-    """Unfolded NHWC GroupNorm forward; returns (y, mean, rstd)."""
+    """Unfolded NHWC GroupNorm forward; returns (y, mean, rstd).
+
+    Same coefficient form as :func:`_fgn_forward` (y = x*a + b with small
+    per-(sample, group) f32 coefficients): the activations are read in
+    their stored dtype by exactly one reduce and one elementwise pass, so
+    no relayouted f32 activation copy materializes for the conv
+    weight-grad recompute to re-read at 2x bytes."""
     b, h, w, c = x.shape
     cpg = c // g
-    x32 = x.astype(jnp.float32).reshape(b, h, w, g, cpg)
+    x5 = x.reshape(b, h, w, g, cpg)
+    x32 = x5.astype(jnp.float32)
     mean = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)
     mean2 = jnp.mean(jnp.square(x32), axis=(1, 2, 4), keepdims=True)
     var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
     rstd = jax.lax.rsqrt(var + eps)
-    norm = ((x32 - mean) * rstd).reshape(b, h, w, c)
-    return (norm * scale + bias).astype(out_dtype), mean, rstd
+    scale5 = scale.reshape(g, cpg)
+    a = rstd * scale5
+    # Subtract-first (same rationale as _fgn_forward): folding mean into
+    # the additive coefficient cancels catastrophically when
+    # |x - mean| << |x|.
+    y = ((x5 - mean) * a + bias.reshape(g, cpg)).astype(out_dtype)
+    y = y.reshape(b, h, w, c)
+    return y, mean, rstd
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -265,18 +317,22 @@ def _pgn_bwd(g: int, eps: float, out_dtype, res, dy):
     x, scale, bias, mean, rstd = res
     b, h, w, c = x.shape
     cpg = c // g
-    x32 = x.astype(jnp.float32).reshape(b, h, w, g, cpg)
+    x5 = x.reshape(b, h, w, g, cpg)
+    dy5 = dy.reshape(b, h, w, g, cpg)
+    x32 = x5.astype(jnp.float32)
+    dy32 = dy5.astype(jnp.float32)
+    scale5 = scale.reshape(g, cpg)
     xhat = (x32 - mean) * rstd
-    dy32 = dy.astype(jnp.float32)
-    dyg = (dy32 * scale).reshape(b, h, w, g, cpg)
+    dyg = dy32 * scale5
     m1 = jnp.mean(dyg, axis=(1, 2, 4), keepdims=True)
     m2 = jnp.mean(dyg * xhat, axis=(1, 2, 4), keepdims=True)
-    dx = (rstd * (dyg - m1 - xhat * m2)).reshape(b, h, w, c)
-    dscale = jnp.sum(
-        dy32 * xhat.reshape(b, h, w, c), axis=(0, 1, 2)
-    ).astype(scale.dtype)
-    dbias = jnp.sum(dy32, axis=(0, 1, 2)).astype(bias.dtype)
-    return dx.astype(x.dtype), dscale, dbias
+    # Same subtract-first numerics as _fgn_bwd: bf16 reads, f32 register
+    # math, xhat recomputed in-register rather than folding mean into an
+    # additive coefficient (cancellation — see _fgn_forward).
+    dx = ((dyg - m1 - xhat * m2) * rstd).astype(x.dtype).reshape(b, h, w, c)
+    dscale = jnp.sum(dy32 * xhat, axis=(0, 1, 2)).reshape(c).astype(scale.dtype)
+    dbias = jnp.sum(dy32, axis=(0, 1, 2)).reshape(c).astype(bias.dtype)
+    return dx, dscale, dbias
 
 
 _plain_group_norm.defvjp(_pgn_fwd, _pgn_bwd)

@@ -60,8 +60,14 @@ def subset_weighted_mean(stacked_tree, weights, mask, fallback_tree):
     nonempty = total > 0
 
     def _leaf(x, fb):
-        avg = jnp.tensordot(norm.astype(x.dtype), x, axes=(0, 0))
-        return jnp.where(nonempty, avg, fb)
+        # preferred_element_type: accumulate in f32 even when the stack is
+        # read in bf16 (shapley_eval_dtype) — the MXU's native
+        # bf16-in/f32-out contraction; a no-op for f32 stacks.
+        avg = jnp.tensordot(
+            norm.astype(x.dtype), x, axes=(0, 0),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.where(nonempty, avg, fb.astype(avg.dtype))
 
     return jax.tree_util.tree_map(_leaf, stacked_tree, fallback_tree)
 

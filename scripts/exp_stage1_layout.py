@@ -1,0 +1,131 @@
+"""Experiment: stage-1 activation orientation — NHWC vs HWNC.
+
+MEASURED NEGATIVE RESULT (round 5, kept for the record): this isolated
+chain shows HWNC 3.7x faster (92.8 -> 24.8 ms for 4 blocks fwd+bwd at
+chunk 40), yet wiring the same orientation into the real model made the
+sign_SGD ROUND 7% slower (2.72 -> 2.91 s/round) and left the bf16
+fed/fed_quant rounds flat — the full round has consumers (stem boundary,
+per-step vote, custom-vjp GroupNorm residual flow) that re-introduce
+relayouts the isolated chain doesn't have. Third instance of the
+round-3 lesson: isolated conv microbenches lie; only in-context
+measurement decides.
+
+Background: the round-5 sign_SGD trace showed ~240 ms/round of relayout
+copies on the folded stage-1 activations — the grouped-conv backend emits
+``{3,0,2,1}`` (batch in sublanes) while the GroupNorm reduces and
+elementwise passes want ``{3,2,1,0}``, and XLA reconciles with
+materialized (partly f32-upcast) copies whose consumers include the conv
+weight-grad fusions (HLO-verified). HWNC removes them HERE but not in
+the whole program.
+
+Measures a 2-conv + 2-GroupNorm + relu residual block chain, vmapped over
+per-client weights (the engine's structure), fwd+bwd, in both
+orientations at the flagship shapes.
+
+Usage: python scripts/exp_stage1_layout.py [n_chain] [chunk] [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from distributed_learning_simulator_tpu.models.resnet import (  # noqa: E402
+    pack_folded_kernel,
+)
+
+
+def timeit(fn, args, n):
+    out = fn(*args)
+    jax.device_get(out)
+    t0 = time.perf_counter()
+    acc = out
+    for _ in range(n):
+        acc = acc + fn(*args)
+    jax.device_get(acc)
+    return (time.perf_counter() - t0) / n
+
+
+def gn_nhwc(x, g=32):
+    b, h, wf, c2 = x.shape
+    cpg = c2 // 2 // g
+    x6 = x.reshape(b, h, wf, 2, g, cpg)
+    x32 = x6.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(1, 2, 3, 5), keepdims=True)
+    mean2 = jnp.mean(jnp.square(x32), axis=(1, 2, 3, 5), keepdims=True)
+    rstd = jax.lax.rsqrt(jnp.maximum(mean2 - mean * mean, 0.0) + 1e-6)
+    return ((x6 - mean) * rstd).astype(x.dtype).reshape(b, h, wf, c2)
+
+
+def gn_hwnc(x, g=32):
+    h, wf, b, c2 = x.shape
+    cpg = c2 // 2 // g
+    x6 = x.reshape(h, wf, b, 2, g, cpg)
+    x32 = x6.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 1, 3, 5), keepdims=True)
+    mean2 = jnp.mean(jnp.square(x32), axis=(0, 1, 3, 5), keepdims=True)
+    rstd = jax.lax.rsqrt(jnp.maximum(mean2 - mean * mean, 0.0) + 1e-6)
+    return ((x6 - mean) * rstd).astype(x.dtype).reshape(h, wf, b, c2)
+
+
+def make_chain(orient: str, n_chain: int):
+    if orient == "nhwc":
+        dn = ("NHWC", "HWIO", "NHWC")
+        gn = gn_nhwc
+    else:
+        dn = ("HWNC", "HWIO", "HWNC")
+        gn = gn_hwnc
+
+    def block(x, w):
+        wp = pack_folded_kernel(w.astype(jnp.bfloat16))
+        y = jax.lax.conv_general_dilated(
+            x.astype(jnp.bfloat16), wp, (1, 1), "SAME",
+            dimension_numbers=dn,
+        )
+        return jax.nn.relu(gn(y) + x)
+
+    def one_client(ws, x):
+        def loss(ws):
+            y = x
+            for w in ws:
+                y = block(y, w)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        return jax.grad(loss)(ws)
+
+    def run(ws_all, x_all):
+        g = jax.vmap(one_client)(ws_all, x_all)
+        return sum(jnp.sum(w.astype(jnp.float32)) for w in g)
+
+    return jax.jit(run), n_chain
+
+
+def main():
+    n_chain = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+    key = jax.random.key(0)
+    ws = [
+        jax.random.normal(jax.random.fold_in(key, i),
+                          (chunk, 3, 3, 64, 64), jnp.float32) * 0.05
+        for i in range(n_chain)
+    ]
+    x_nhwc = jax.random.normal(key, (chunk, batch, 32, 16, 128),
+                               jnp.bfloat16)
+    # HWNC per-client logical shape [32, 16, batch, 128]
+    x_hwnc = jnp.transpose(x_nhwc, (0, 2, 3, 1, 4))
+    for orient, x in (("nhwc", x_nhwc), ("hwnc", x_hwnc)):
+        fn, _ = make_chain(orient, n_chain)
+        t = timeit(fn, (ws, x), 10)
+        print(f"{orient}: {t * 1e3:8.2f} ms for {n_chain} blocks "
+              f"fwd+bwd at chunk {chunk} x batch {batch}")
+
+
+if __name__ == "__main__":
+    main()

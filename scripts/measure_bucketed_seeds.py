@@ -1,0 +1,78 @@
+"""Multi-seed accuracy evidence for size-aware work scheduling.
+
+VERDICT r4 weak #3: the converged named-config comparison (non-IID
+Dirichlet(0.1), 1000 clients, ResNet-18) showed scheduling ON at 0.8142 vs
+OFF at 0.7800 on ONE seed, attributed to reshuffle-class batch-composition
+noise without variance evidence. This script runs the same scale at a
+cheaper horizon over several seeds, scheduling ON and OFF, so the claim
+carries a spread: either the ON/OFF bands overlap (scheduling is
+accuracy-neutral at this config) or they don't (the schedule shifts
+convergence and the docs must say so).
+
+The seed drives the Dirichlet split, model init, and training RNG — ON and
+OFF at the same seed train on identical data from identical inits; only
+batch composition (which samples share a step's masked slots) differs.
+
+Usage: python scripts/measure_bucketed_seeds.py [rounds] [seeds...]
+(defaults: 50 rounds, seeds 0 1 2)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    seeds = [int(s) for s in sys.argv[2:]] or [0, 1, 2]
+
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    results = {}
+    for seed in seeds:
+        for sched in (True, False):
+            config = ExperimentConfig(
+                dataset_name="cifar10", model_name="resnet18",
+                distributed_algorithm="fed", worker_number=1000,
+                round=rounds, epoch=1, learning_rate=0.02, momentum=0.9,
+                batch_size=25, partition="dirichlet", dirichlet_alpha=0.1,
+                max_shard_size=100, client_chunk_size=40,
+                local_compute_dtype="bfloat16", eval_batch_size=10000,
+                lr_schedule="cosine", lr_min_factor=0.1,
+                bucket_client_work=sched, seed=seed, log_level="WARNING",
+            )
+            t0 = time.perf_counter()
+            res = run_simulation(config, setup_logging=False)
+            wall = time.perf_counter() - t0
+            accs = [h["test_accuracy"] for h in res["history"]]
+            key = f"seed{seed}_{'on' if sched else 'off'}"
+            results[key] = {
+                "final_accuracy": accs[-1],
+                "last5_mean": sum(accs[-5:]) / 5,
+                "wall_s": round(wall, 1),
+                "round_s": round(
+                    sum(h["round_seconds"] for h in res["history"][1:])
+                    / max(len(accs) - 1, 1), 3,
+                ),
+            }
+            print(key, json.dumps(results[key]), flush=True)
+    on = [v["final_accuracy"] for k, v in results.items() if k.endswith("_on")]
+    off = [v["final_accuracy"] for k, v in results.items() if k.endswith("_off")]
+    mean = lambda xs: sum(xs) / len(xs)  # noqa: E731
+    spread = lambda xs: max(xs) - min(xs)  # noqa: E731
+    print(json.dumps({
+        "rounds": rounds, "seeds": seeds,
+        "on_final": on, "off_final": off,
+        "on_mean": round(mean(on), 4), "off_mean": round(mean(off), 4),
+        "on_spread": round(spread(on), 4), "off_spread": round(spread(off), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

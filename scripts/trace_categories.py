@@ -26,7 +26,10 @@ RULES = [
     ("stage4", r"4,4,512|2,2,512"),
     ("stage3", r"8,8,256"),
     ("stage2", r"16,16,128"),
-    ("stage1f", r"32,16,128|3,3,128,40,128|3,4,3,40,128"),
+    # stage-1 folded activations: NHWC [.., 32, 16, 128] (rounds 3-4) or
+    # HWNC [32, 16, .., 128] (round 5); packed kernels/grads either way.
+    ("stage1f", r"32,16,128|32,16,40,25,128|32,16,1000,128"
+                r"|3,3,128,40,128|3,4,3,40,128"),
     ("dense/head", r"512,10|,10\]"),
     ("decode", r"u8\[|s32\["),
 ]

@@ -91,38 +91,74 @@ def test_folded_resnet_gradients_match_unfolded():
     must route gradients back to the SAME unpacked parameters: compare
     d loss / d params between folded and unfolded models in f32.
     Forward equality alone would not catch a scatter/duplication bug in
-    the backward of pack_folded_kernel."""
+    the backward of pack_folded_kernel.
+
+    Comparison metric: the two models compute the same math with
+    different op orders (packed vs plain conv contractions, 6D vs 5D
+    GroupNorm stat reduces), so forward activations differ by ~1 f32
+    ulp — and a ulp-scale perturbation that lands exactly on a ReLU
+    threshold flips that element's backward mask, producing isolated
+    O(1e-3) gradient diffs that elementwise rtol cannot distinguish
+    from real bugs (measured round 5: swapping ReLU for softplus in
+    BOTH models collapses the worst per-leaf relative L2 from 4.3e-3
+    to 6.3e-6). So this test runs two legs: a STRICT leg with a smooth
+    activation (pure routing check, no flip noise — a scatter bug moves
+    O(1) relative mass) and a loose leg on the real ReLU model."""
+    import distributed_learning_simulator_tpu.models.resnet as resnet_mod
+
     x = np.asarray(
         jax.random.normal(jax.random.key(5), (4, 32, 32, 3), jnp.float32)
     )
     y = np.asarray(
         jax.random.randint(jax.random.key(6), (4,), 0, 10)
     )
-    unfolded_model = ResNet18(fold_stage1=False, dtype=jnp.float32)
-    folded_model = ResNet18(fold_stage1=True, dtype=jnp.float32)
-    pu = unfolded_model.init(jax.random.key(0), x[:1])["params"]
-    pf = _transplant(pu, folded_model.init(jax.random.key(0), x[:1])["params"])
 
-    def loss(model, p):
-        logits = model.apply({"params": p}, x)
-        logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
-
-    gu = jax.grad(lambda p: loss(unfolded_model, p))(pu)
-    gf = jax.grad(lambda p: loss(folded_model, p))(pf)
-    # Compare via the same transplant mapping, in the folded tree's shape.
-    gu_in_folded = _transplant(gu, gf)
-    for (ku, lu), (kf, lf) in zip(
-        sorted(jax.tree_util.tree_leaves_with_path(gu_in_folded),
-               key=lambda kv: str(kv[0])),
-        sorted(jax.tree_util.tree_leaves_with_path(gf),
-               key=lambda kv: str(kv[0])),
-    ):
-        assert str(ku) == str(kf)
-        np.testing.assert_allclose(
-            np.asarray(lf), np.asarray(lu), rtol=2e-3, atol=2e-5,
-            err_msg=str(ku),
+    def worst_rel_l2():
+        unfolded_model = ResNet18(fold_stage1=False, dtype=jnp.float32)
+        folded_model = ResNet18(fold_stage1=True, dtype=jnp.float32)
+        pu = unfolded_model.init(jax.random.key(0), x[:1])["params"]
+        pf = _transplant(
+            pu, folded_model.init(jax.random.key(0), x[:1])["params"]
         )
+
+        def loss(model, p):
+            logits = model.apply({"params": p}, x)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+        gu = jax.grad(lambda p: loss(unfolded_model, p))(pu)
+        gf = jax.grad(lambda p: loss(folded_model, p))(pf)
+        # Compare via the same transplant mapping, in the folded tree's
+        # shape.
+        gu_in_folded = _transplant(gu, gf)
+        worst = ("", 0.0)
+        for (ku, lu), (kf, lf) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(gu_in_folded),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(gf),
+                   key=lambda kv: str(kv[0])),
+        ):
+            assert str(ku) == str(kf)
+            a, b = np.asarray(lf), np.asarray(lu)
+            rel = np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-12)
+            if rel > worst[1]:
+                worst = (str(ku), float(rel))
+        return worst
+
+    # Strict leg: smooth activation in BOTH models — no ReLU-flip noise,
+    # so any routing/duplication bug in the packing transpose shows as
+    # O(1) relative mass against a ~1e-5 float noise floor.
+    orig_relu = resnet_mod.nn.relu
+    resnet_mod.nn.relu = jax.nn.softplus
+    try:
+        key, rel = worst_rel_l2()
+        assert rel < 1e-4, (key, rel)
+    finally:
+        resnet_mod.nn.relu = orig_relu
+    # Loose leg: the real ReLU model — bounds flip noise (isolated
+    # elements at ~1e-3) while still far below a packing bug's O(1).
+    key, rel = worst_rel_l2()
+    assert rel < 2e-2, (key, rel)
 
 
 def test_plain_group_norm_matches_flax():

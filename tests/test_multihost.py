@@ -96,49 +96,114 @@ _WORKER_CODE = textwrap.dedent("""
 
 
 _TRAIN_CODE = textwrap.dedent("""
+    import json
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
     from distributed_learning_simulator_tpu.config import ExperimentConfig
     from distributed_learning_simulator_tpu.simulator import run_simulation
 
+    extra = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
     config = ExperimentConfig(
         dataset_name="synthetic", model_name="mlp",
-        distributed_algorithm="fed", worker_number=8, round=2, epoch=1,
-        learning_rate=0.1, n_train=256, n_test=128, log_level="ERROR",
+        distributed_algorithm=extra.pop("distributed_algorithm", "fed"),
+        worker_number=8, round=2, epoch=1,
+        learning_rate=extra.pop("learning_rate", 0.1),
+        n_train=256, n_test=128, log_level="ERROR",
         multihost=True, coordinator_address=sys.argv[1], num_processes=2,
-        process_id=int(sys.argv[2]), mesh_devices=2,
+        process_id=int(sys.argv[2]), mesh_devices=2, **extra,
     )
     res = run_simulation(config, setup_logging=False)
     accs = [h["test_accuracy"] for h in res["history"]]
     assert len(accs) == 2 and all(a == a for a in accs)
+    svs = [h.get("shapley_values") for h in res["history"]]
+    if any(sv is not None for sv in svs):
+        flat = [round(sv[i], 6) for sv in svs for i in sorted(sv)]
+        assert all(v == v for v in flat), flat  # finite
+        print("SV_OK", sys.argv[2], ",".join(map(str, flat)))
     print("TRAIN_OK", sys.argv[2], accs[-1])
 """)
 
 
-def test_two_process_full_simulation():
-    """The ENTIRE simulation runs SPMD across two processes: client axis
-    sharded over a 2-device mesh spanning both, aggregation riding the
-    cross-process (DCN-analog) path, identical metrics on both sides."""
+def _run_two_process_train(extra: dict | None = None) -> list[str]:
+    """Launch the SPMD simulation in two processes; return their stdouts
+    (both asserted rc=0)."""
+    import json
+
     port = _free_port()
     addr = f"127.0.0.1:{port}"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     repo = os.path.join(os.path.dirname(__file__), "..")
+    args = [json.dumps(extra)] if extra else []
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _TRAIN_CODE, addr, str(i)],
+            [sys.executable, "-c", _TRAIN_CODE, addr, str(i), *args],
             cwd=repo, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True,
         )
         for i in range(2)
     ]
     outs = [p.communicate(timeout=300) for p in procs]
-    finals = []
     for i, (p, (out, err)) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (i, out, err)
-        line = [ln for ln in out.splitlines() if ln.startswith("TRAIN_OK")][0]
-        finals.append(line.split()[2])
+    return [out for out, _ in outs]
+
+
+def _final_accs(outs: list[str]) -> list[str]:
+    return [
+        [ln for ln in out.splitlines() if ln.startswith("TRAIN_OK")][0]
+        .split()[2]
+        for out in outs
+    ]
+
+
+def test_two_process_full_simulation():
+    """The ENTIRE simulation runs SPMD across two processes: client axis
+    sharded over a 2-device mesh spanning both, aggregation riding the
+    cross-process (DCN-analog) path, identical metrics on both sides."""
+    finals = _final_accs(_run_two_process_train())
     assert finals[0] == finals[1]  # SPMD: both processes see the same model
+
+
+def test_two_process_sign_sgd():
+    """sign_SGD's per-OPTIMIZER-STEP majority vote (reference
+    workers/sign_sgd_worker.py:44-46 — the system's highest-frequency sync)
+    across a process boundary: the sign/sum/sign reduction rides the
+    cross-process collective every local step, and both processes must
+    land on the same model."""
+    finals = _final_accs(_run_two_process_train(
+        {"distributed_algorithm": "sign_SGD", "learning_rate": 0.01}
+    ))
+    assert finals[0] == finals[1]
+
+
+def test_two_process_fed_quant():
+    """fed_quant's per-client payload RNG (hash-dither stochastic quantize
+    of both exchange directions) under cross-process sharding: the dither
+    is a pure function of value bits + per-client salt, so placement
+    cannot change it — both processes must agree."""
+    finals = _final_accs(_run_two_process_train(
+        {"distributed_algorithm": "fed_quant", "client_eval": False}
+    ))
+    assert finals[0] == finals[1]
+
+
+def test_two_process_multiround_shapley():
+    """Exact-Shapley post_round consuming a client-params stack SHARDED
+    ACROSS PROCESSES: subset weighted means are einsums over the
+    cross-process client axis, and the resulting per-round SVs must be
+    finite and identical on both sides."""
+    outs = _run_two_process_train(
+        {"distributed_algorithm": "multiround_shapley_value"}
+    )
+    finals = _final_accs(outs)
+    assert finals[0] == finals[1]
+    svs = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("SV_OK")]
+        assert lines, out  # the shapley path actually produced values
+        svs.append(lines[0].split()[2])
+    assert svs[0] == svs[1]
 
 
 _RESUME_CODE = textwrap.dedent("""

@@ -57,14 +57,15 @@ def test_symmetry_property():
 
 def test_shapley_eval_chunk_invariant(tiny_config):
     """shapley_eval_chunk is pure batching: per-round SVs must be identical
-    across chunk sizes (including one that doesn't divide the subset
-    count)."""
+    across chunk sizes — including one that doesn't divide the subset count
+    and the production values the N=1000 GTG measurements use (64, 128 —
+    docs/PERFORMANCE.md § Scale validation)."""
     import dataclasses
 
     from distributed_learning_simulator_tpu.simulator import run_simulation
 
     svs = []
-    for chunk in (16, 5, 64):
+    for chunk in (16, 5, 64, 128):
         cfg = dataclasses.replace(
             tiny_config, distributed_algorithm="multiround_shapley_value",
             round=2, shapley_eval_chunk=chunk,
@@ -77,6 +78,37 @@ def test_shapley_eval_chunk_invariant(tiny_config):
                 [h0[i] for i in sorted(h0)], [h1[i] for i in sorted(h1)],
                 rtol=1e-6, atol=1e-9,
             )
+
+
+def test_shapley_eval_dtype_agreement(tiny_config):
+    """shapley_eval_dtype='bfloat16' (default: halved stack reads) must
+    produce SVs within a small tolerance of the f32 evaluator on the same
+    round — utilities feed an argmax accuracy, and the weighted mean still
+    accumulates f32, so the perturbation is per-subset bf16 rounding of
+    the client params only. Also covers the GTG walk: truncation decisions
+    may differ at the eps boundary, so GTG compares the SV VECTOR with a
+    loose tolerance rather than requiring identical walks."""
+    import dataclasses
+
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    for algo, tol in (
+        ("multiround_shapley_value", 0.02),
+        ("GTG_shapley_value", 0.05),
+    ):
+        out = {}
+        for dtype in ("float32", "bfloat16"):
+            cfg = dataclasses.replace(
+                tiny_config, distributed_algorithm=algo, round=2,
+                shapley_eval_dtype=dtype,
+            )
+            res = run_simulation(cfg, setup_logging=False)
+            out[dtype] = [h["shapley_values"] for h in res["history"]]
+        for h32, h16 in zip(out["float32"], out["bfloat16"]):
+            v32 = np.array([h32[i] for i in sorted(h32)])
+            v16 = np.array([h16[i] for i in sorted(h16)])
+            assert np.all(np.isfinite(v16))
+            np.testing.assert_allclose(v16, v32, atol=tol)
 
 
 def test_exact_refuses_large_n(tiny_config):
