@@ -41,11 +41,37 @@ round-3 tap-einsum lesson, re-learned twice).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Sequence
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+from distributed_learning_simulator_tpu.ops.gn_pallas import pallas_group_norm
+
+
+def _use_pallas_gn() -> bool:
+    """Opt-in Pallas GroupNorm forward (``DLS_GN_PALLAS=1``, TPU only).
+
+    MEASURED NEGATIVE RESULT (round 5): the kernels (ops/gn_pallas.py)
+    do exactly what the trace analysis asked for — the conv emits bf16
+    (a Pallas call is an opaque consumer, so XLA cannot fuse the stats'
+    f32 convert into the conv epilogue), stats read the activations once
+    with in-register converts, normalize reads them once more — and the
+    REAL rounds got slower anyway: sign_SGD 2.72 -> 3.37 s/round, fed
+    flagship 2.22 -> 2.84. The f32-activation "tax" the jnp path pays is
+    XLA's price for fusing normalize/relu/residual/wgrad-recompute into
+    neighboring ops, and that fusion is worth more than the saved
+    bytes. Third structural attack on the stage-1 f32 sharing (after
+    HWNC orientation and optimization_barrier, module docstring), third
+    in-context rejection — the jnp path stands as the measured floor."""
+    if os.environ.get("DLS_GN_PALLAS", "0") != "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend not initialized yet
+        return False
 
 
 def pack_folded_kernel(w):
@@ -197,6 +223,16 @@ def _fgn_forward(xf, scale, bias, g: int, eps: float, out_dtype):
     b, h, wf, c2 = xf.shape
     c = c2 // 2
     cpg = c // g
+    if _use_pallas_gn():
+        y, mean_g, rstd_g = pallas_group_norm(
+            xf, jnp.tile(scale, 2), jnp.tile(bias, 2), g, eps, out_dtype,
+            folds=2,
+        )
+        return (
+            y,
+            mean_g.reshape(b, 1, 1, 1, g, 1),
+            rstd_g.reshape(b, 1, 1, 1, g, 1),
+        )
     x6 = xf.reshape(b, h, wf, 2, g, cpg)
     x32 = x6.astype(jnp.float32)
     # One-pass statistics (E[x^2] - E[x]^2, flax's use_fast_variance):
@@ -285,6 +321,15 @@ def _gn_forward(x, scale, bias, g: int, eps: float, out_dtype):
     weight-grad recompute to re-read at 2x bytes."""
     b, h, w, c = x.shape
     cpg = c // g
+    if _use_pallas_gn():
+        y, mean_g, rstd_g = pallas_group_norm(
+            x, scale, bias, g, eps, out_dtype, folds=1,
+        )
+        return (
+            y,
+            mean_g.reshape(b, 1, 1, g, 1),
+            rstd_g.reshape(b, 1, 1, g, 1),
+        )
     x5 = x.reshape(b, h, w, g, cpg)
     x32 = x5.astype(jnp.float32)
     mean = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)

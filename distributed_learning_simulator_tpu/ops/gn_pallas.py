@@ -1,0 +1,167 @@
+"""Pallas GroupNorm forward: bf16-in, one-pass stats, no f32 activations.
+
+MEASURED NEGATIVE RESULT — kept as an opt-in (``DLS_GN_PALLAS=1``) with
+the evidence recorded; the default path is the jnp forward in
+models/resnet.py. In-context rounds got SLOWER with these kernels
+(sign_SGD 2.72 -> 3.37 s/round, fed flagship 2.22 -> 2.84) even though
+they deliver exactly the byte-level property the trace analysis asked
+for — see the story below and `_use_pallas_gn` in models/resnet.py.
+
+Why it was built (round 5, HLO + device-trace evidence): with the jnp
+GroupNorm forward, XLA fuses the stats' ``astype(f32)`` into the
+PRODUCING conv's epilogue (``convolution_convert_fusion``), so the conv
+writes the stage activations in f32 and every consumer — the stats
+reduce, the normalize pass, and the next conv's weight-grad recompute —
+re-reads them at 2x bytes; on the flagship ResNet round this f32 tax
+plus the associated relayout copies is ~0.4 s/round. Neither
+re-orienting the layout (HWNC) nor ``optimization_barrier`` removed it
+in context (both measured slower overall — models/resnet.py module
+docstring). A Pallas kernel is an *opaque* consumer: the conv must emit
+bf16, the stats kernel converts in-register and reads the activations
+exactly once, and the normalize kernel reads them once more with small
+per-(sample, channel) f32 coefficient rows. That all happens — and the
+fusion XLA loses at the opaque boundary (normalize/relu/residual/wgrad
+recompute stitched into neighboring ops) costs more than the bytes
+saved. The f32 epilogue is XLA's side of a trade it is winning.
+
+Semantics match the jnp forms in models/resnet.py to fp-reduction
+tolerance: one-pass E[x^2]-E[x]^2 statistics, subtract-first normalize
+``y = (x - mean) * (rstd * scale) + bias``. The closed-form BACKWARD
+stays jnp (models/resnet.py `_fgn_bwd`/`_pgn_bwd`): its reduces already
+read the bf16 residuals inline (trace-verified), so there is nothing to
+win there.
+
+Shapes: callers flatten to ``x [B, HW, C]``; group structure is carried
+by a channel->group index (folded layouts pool the two tx channel blocks
+into the same group — models/resnet.py `FoldedGroupNorm`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _batch_tile(b: int) -> int:
+    """Mosaic block rule: the [bt, C] stats blocks need bt % 8 == 0 or
+    bt == b. b=8k (big eval batches, flattened client stacks) tiles at 8;
+    otherwise one whole-array block (b=25 per-client batches: the
+    [25, 512, 128] bf16 input block is 3.3 MB — well inside VMEM)."""
+    return 8 if b % 8 == 0 else b
+
+
+def _hw_tile(hw: int) -> int:
+    """Row tile: bounds the kernel's in-VMEM f32 intermediates (a whole
+    [25, 512, 128] block OOMed the 16 MB scoped vmem under vmap)."""
+    return 128 if hw % 128 == 0 else hw
+
+
+def _stats_kernel(x_ref, s1_ref, s2_ref):
+    x = x_ref[...].astype(jnp.float32)  # [bt, ht, C]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    s1_ref[...] += jnp.sum(x, axis=1)
+    s2_ref[...] += jnp.sum(x * x, axis=1)
+
+
+def _norm_kernel(x_ref, m_ref, a_ref, b_ref, y_ref):
+    x = x_ref[...].astype(jnp.float32)      # [bt, ht, C]
+    m = m_ref[...][:, None, :]              # [bt, 1, C]
+    a = a_ref[...][:, None, :]
+    bb = b_ref[...][None, :, :]             # [1, 1, C] bias row
+    y_ref[...] = ((x - m) * a + bb).astype(y_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _column_stats(xr, bt: int, ht: int):
+    b, hw, c = xr.shape
+    return pl.pallas_call(
+        _stats_kernel,
+        grid=(b // bt, hw // ht),
+        in_specs=[pl.BlockSpec((bt, ht, c), lambda i, j: (i, j, 0))],
+        out_specs=[
+            pl.BlockSpec((bt, c), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, c), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, c), jnp.float32),
+        ],
+    )(xr)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _normalize(xr, mean_c, a_c, bias_c, bt: int, ht: int, out_dtype):
+    b, hw, c = xr.shape
+    return pl.pallas_call(
+        _norm_kernel,
+        grid=(b // bt, hw // ht),
+        in_specs=[
+            pl.BlockSpec((bt, ht, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((bt, c), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, c), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, ht, c), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(xr.shape, out_dtype),
+    )(xr, mean_c, a_c, bias_c)
+
+
+def _per_group(col_stats, g: int, folds: int):
+    """[B, C] per-channel sums -> [B, G] per-group sums, exactly.
+
+    folds=1: plain ``[g, cpg]`` channel blocks; folds=2: channel
+    ``c' = tx*(c/2) + grp*cpg + i`` (FoldedGroupNorm's layout) — both tx
+    blocks of a group pool into the same statistics. Pure f32 VPU adds
+    via reshape (a one-hot matmul here runs at the MXU's default
+    reduced-precision f32 passes and cost ~2e-3 relative on the means —
+    measured)."""
+    b, c = col_stats.shape
+    base = c // folds
+    cpg = base // g
+    return jnp.sum(col_stats.reshape(b, folds, g, cpg), axis=(1, 3))
+
+
+def _per_channel(group_vals, cpg: int, folds: int):
+    """[B, G] per-group values -> [B, C] per-channel rows (layout
+    inverse of :func:`_per_group`)."""
+    return jnp.tile(jnp.repeat(group_vals, cpg, axis=1), (1, folds))
+
+
+def pallas_group_norm(x, scale_full, bias_full, g: int, eps: float,
+                      out_dtype, folds: int):
+    """GroupNorm forward on ``x [B, H, W, C]``.
+
+    ``scale_full``/``bias_full`` are per-CHANNEL (length C — already
+    tx-tiled by the caller for folded layouts). Returns
+    ``(y [B,H,W,C], mean_g [B,G] f32, rstd_g [B,G] f32)``; the caller
+    reshapes mean/rstd to its residual convention.
+    """
+    b, h, w, c = x.shape
+    hw = h * w
+    xr = x.reshape(b, hw, c)
+    bt = _batch_tile(b)
+    ht = _hw_tile(hw)
+    s1, s2 = _column_stats(xr, bt, ht)
+    cnt = hw * (c // g)
+    cpg = c // folds // g
+    mean_g = _per_group(s1, g, folds) / cnt
+    var = jnp.maximum(
+        _per_group(s2, g, folds) / cnt - jnp.square(mean_g), 0.0
+    )
+    rstd_g = jax.lax.rsqrt(var + eps)
+    mean_c = _per_channel(mean_g, cpg, folds)          # [B, C]
+    a_c = _per_channel(rstd_g, cpg, folds) * scale_full[None, :]
+    y = _normalize(
+        xr, mean_c, a_c, bias_full[None, :].astype(jnp.float32), bt, ht,
+        jnp.dtype(out_dtype),
+    )
+    return y.reshape(b, h, w, c), mean_g, rstd_g

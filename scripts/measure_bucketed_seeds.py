@@ -54,7 +54,7 @@ def main():
             key = f"seed{seed}_{'on' if sched else 'off'}"
             results[key] = {
                 "final_accuracy": accs[-1],
-                "last5_mean": sum(accs[-5:]) / 5,
+                "last5_mean": sum(accs[-5:]) / len(accs[-5:]),
                 "wall_s": round(wall, 1),
                 "round_s": round(
                     sum(h["round_seconds"] for h in res["history"][1:])

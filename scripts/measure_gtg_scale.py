@@ -4,10 +4,14 @@ One honest data point (VERDICT r3 weak #7): wall-clock per round,
 permutations per round, subset evaluations, and peak HBM. Run on the real
 chip:
 
-    python scripts/measure_gtg_scale.py [rounds] [eval_samples] [eval_chunk]
+    python scripts/measure_gtg_scale.py [rounds] [eval_samples] [eval_chunk] \
+        [max_permutations] [eval_dtype]
 
 (eval_chunk default 64 — the chunk-16-vs-64 comparison in
-docs/PERFORMANCE.md § Scale validation is reproduced by passing 16/64.)
+docs/PERFORMANCE.md § Scale validation is reproduced by passing 16/64.
+max_permutations 0 = auto cap max(500, 2N); pass 1000 to reproduce the
+round-4 one-iteration fixed-budget measurement. eval_dtype default
+bfloat16 = config default; pass float32 for the r4 configuration.)
 """
 
 from __future__ import annotations
@@ -25,6 +29,8 @@ def main():
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
     eval_samples = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
     eval_chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    max_perms = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    eval_dtype = sys.argv[5] if len(sys.argv) > 5 else "bfloat16"
 
     from distributed_learning_simulator_tpu.config import ExperimentConfig
     from distributed_learning_simulator_tpu.simulator import run_simulation
@@ -35,6 +41,8 @@ def main():
         round=rounds, epoch=1, learning_rate=0.1, momentum=0.9,
         batch_size=25, client_chunk_size=250, eval_batch_size=10000,
         shapley_eval_samples=eval_samples, shapley_eval_chunk=eval_chunk,
+        gtg_max_permutations=max_perms or None,
+        shapley_eval_dtype=eval_dtype,
         log_level="INFO",
     )
     t0 = time.perf_counter()

@@ -297,3 +297,49 @@ def test_folded_resnet_trains(tiny_config):
     )
     res = run_simulation(cfg, setup_logging=False)
     assert np.isfinite(res["history"][-1]["test_loss"])
+
+
+def test_pallas_gn_matches_jnp():
+    """Pallas GroupNorm forward (ops/gn_pallas.py) vs the jnp form: stats
+    to f32-reduction tolerance, outputs within one bf16 ulp. The suite
+    pins the CPU backend (conftest), where the Mosaic kernels don't
+    exist — this test runs when invoked on a TPU host directly:
+    ``JAX_PLATFORMS= python -m pytest tests/test_folded_resnet.py -k pallas``.
+    """
+    import os
+
+    import pytest
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("pallas GN kernels are Mosaic-only (suite runs on CPU)")
+    import distributed_learning_simulator_tpu.models.resnet as R
+
+    rng = np.random.default_rng(0)
+    xf = jnp.asarray(
+        rng.normal(size=(25, 32, 16, 128)).astype(np.float32) * 2 + 1.5,
+        jnp.bfloat16,
+    )
+    scale = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+    bias = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+    prev = os.environ.get("DLS_GN_PALLAS")
+    try:
+        os.environ["DLS_GN_PALLAS"] = "0"
+        y0, m0, r0 = R._fgn_forward(xf, scale, bias, 32, 1e-6, jnp.bfloat16)
+        os.environ["DLS_GN_PALLAS"] = "1"
+        y1, m1, r1 = R._fgn_forward(xf, scale, bias, 32, 1e-6, jnp.bfloat16)
+    finally:
+        if prev is None:
+            os.environ.pop("DLS_GN_PALLAS", None)
+        else:
+            os.environ["DLS_GN_PALLAS"] = prev
+    np.testing.assert_allclose(
+        np.asarray(m1.reshape(-1)), np.asarray(m0.reshape(-1)), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(r1.reshape(-1)), np.asarray(r0.reshape(-1)), rtol=1e-5
+    )
+    d = np.abs(
+        np.asarray(y1, np.float32) - np.asarray(y0, np.float32)
+    )
+    # one output ulp at these magnitudes
+    assert d.max() <= 0.0625, d.max()
