@@ -217,6 +217,12 @@ class ExperimentConfig:
     pipeline_rounds: bool = True
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
+    # First round the profile trace covers (earlier rounds run untraced).
+    # Tracing from round 0 includes the XLA compile, whose host events can
+    # flood the profiler buffer and silently drop device events on
+    # tunneled chips (simulator.py run loop); bench.py's flagship proxy
+    # traces from round 1.
+    profile_from_round: int = 0
     # Persistent XLA compilation cache directory: the round program's
     # ~20-45s first compile is skipped on any later run with the same
     # shapes (including across processes). Disable with None, or from the

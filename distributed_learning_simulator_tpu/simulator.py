@@ -23,7 +23,7 @@ import dataclasses
 import json
 import os
 import time
-from contextlib import contextmanager
+from contextlib import ExitStack, contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -675,12 +675,32 @@ def run_simulation(
                 algo_state, p["key"],
             )
 
-    with profile_session(config.profile_dir):
+    profile_from = getattr(config, "profile_from_round", 0)
+    with ExitStack() as profile_stack:
+        if config.profile_dir and profile_from <= start_round:
+            profile_stack.enter_context(profile_session(config.profile_dir))
+            profile_from = None  # entered
         # try/finally: if a later round crashes (OOM, preemption, SIGINT),
         # the deferred round that already completed on device still gets its
         # metrics line and checkpoint written before unwinding.
         try:
             for round_idx in range(start_round, config.round):
+                if (
+                    config.profile_dir
+                    and profile_from is not None
+                    and round_idx >= profile_from
+                ):
+                    # Deferred trace start (config.profile_from_round):
+                    # round 0's XLA compile floods the tunnel profiler's
+                    # event buffer and device events get dropped —
+                    # measured: whole-loop flagship traces come back
+                    # empty or truncated at a run-varying point, while a
+                    # steady-state round traced after compile captures
+                    # fully (scripts/profile_sign_round.py's method).
+                    profile_stack.enter_context(
+                        profile_session(config.profile_dir)
+                    )
+                    profile_from = None
                 key, round_key = jax.random.split(key)
                 with annotate(f"fl_round_{round_idx}"), _oom_hint(
                     config, global_params, n_clients

@@ -5,9 +5,11 @@
 # diverged params/grads/momenta live in bf16 with hash-dither stochastic
 # rounding (accuracy parity with f32 — mechanism and negative results in
 # docs/PERFORMANCE.md), halving the round's dominant HBM traffic.
-# Measured: 440 clients*rounds/s sustained = 1.32x the pod-rate (round 4:
-# W-folded stage 1 + folded stem + closed-form GroupNorm backward; 385 in
-# round 3). Accuracy-bearing runs: see resnet18_converge_1chip.sh.
+# Measured: 439.5 clients*rounds/s = 1.32x the pod-rate (driver bench
+# incl. per-round eval, round 5; 448-450 on the eval-free profile
+# harness. W-folded stage 1 + folded stem + closed-form GroupNorm
+# backward; 438.6-440 in round 4, 385 in round 3).
+# Accuracy-bearing runs: see resnet18_converge_1chip.sh.
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name cifar10 --model_name resnet18 \
   --distributed_algorithm fed \
