@@ -157,6 +157,17 @@ def _final_accs(outs: list[str]) -> list[str]:
     ]
 
 
+def _sv_values(outs: list[str]) -> list[str]:
+    """Per-process SV_OK payloads (asserts the shapley path produced
+    values in every process)."""
+    svs = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("SV_OK")]
+        assert lines, out
+        svs.append(lines[0].split()[2])
+    return svs
+
+
 def test_two_process_full_simulation():
     """The ENTIRE simulation runs SPMD across two processes: client axis
     sharded over a 2-device mesh spanning both, aggregation riding the
@@ -198,11 +209,24 @@ def test_two_process_multiround_shapley():
     )
     finals = _final_accs(outs)
     assert finals[0] == finals[1]
-    svs = []
-    for out in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("SV_OK")]
-        assert lines, out  # the shapley path actually produced values
-        svs.append(lines[0].split()[2])
+    svs = _sv_values(outs)
+    assert svs[0] == svs[1]
+
+
+def test_two_process_gtg_shapley():
+    """GTG's DATA-DEPENDENT permutation walk across processes: both hosts
+    drive the walk from utilities fetched off cross-process collectives,
+    and every eps-truncation / convergence decision must agree bitwise —
+    a divergent walk issues different batched evaluator calls and the
+    mismatched SPMD programs deadlock (which the subprocess timeout
+    converts into a visible failure). SVs must come out identical."""
+    outs = _run_two_process_train({
+        "distributed_algorithm": "GTG_shapley_value",
+        "shapley_eval_samples": 64,
+    })
+    finals = _final_accs(outs)
+    assert finals[0] == finals[1]
+    svs = _sv_values(outs)
     assert svs[0] == svs[1]
 
 
