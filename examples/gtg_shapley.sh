@@ -2,10 +2,16 @@
 # GTG-Shapley Monte-Carlo contribution scoring: permutation sampling with
 # guided truncation; per-round Shapley values logged and subset metrics
 # pickled to the run's artifact dir. At large N add
-# --shapley_eval_samples 2000 (subset utilities on a test subsample) and
-# --shapley_eval_chunk 64 (amortize the client-stack read across more
-# subsets per batched call): N=1000 cnn_tpu measures 173 s/round
-# (docs/PERFORMANCE.md § Scale validation).
+# --shapley_eval_samples (subset utilities on a test subsample) and
+# --shapley_eval_chunk (amortize the client-stack read across more
+# subsets per batched call). N=1000 cnn_tpu operating points (round 5,
+# docs/PERFORMANCE.md § Scale validation; the evaluator reads the
+# client stack in bf16 by default — measured fidelity-free):
+#   default auto permutation cap max(500, 2N): CONVERGED estimates at
+#     1149-1719 permutations, 264-269 s/round (--shapley_eval_samples
+#     1000 --shapley_eval_chunk 128)
+#   fixed 1000-permutation budget: 90.3 s/round at the same knobs, or
+#     ~168 s/round at --shapley_eval_samples 2000 (r4-equal fidelity)
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name mnist --model_name lenet5 \
   --distributed_algorithm GTG_shapley_value \

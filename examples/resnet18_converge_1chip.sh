@@ -3,9 +3,10 @@
 # accuracy-bearing hyperparameters (lr 0.02 + cosine decay; the bench lr
 # 0.1 is too hot for the GroupNorm ResNet from scratch at 2 steps/round).
 # Measured (docs/PERFORMANCE.md): final test accuracy 0.9459 (bf16+SR)
-# vs 0.9453 (f32) on the CIFAR-shaped surrogate in round 3; round-4 rerun
-# with the folded stem reaches 0.9490 at a sustained 2.35 s/round — the
-# pod-rate margin holds for converged runs, not just short benches.
+# vs 0.9453 (f32) on the CIFAR-shaped surrogate in round 3; 0.9490 in
+# round 4 (folded stem); round-5 rerun reaches 0.9498 at a sustained
+# 438.5 c*r/s over all 150 rounds — the pod-rate margin holds for
+# converged runs, not just short benches.
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name cifar10 --model_name resnet18 \
   --distributed_algorithm fed \

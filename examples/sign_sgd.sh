@@ -4,6 +4,10 @@
 # manual SGD apply. Requires the SGD optimizer. Note the small learning
 # rate: every step moves every parameter by exactly +/-lr, so SignSGD wants
 # lr ~10x below plain SGD's (0.001 here reaches ~0.97 in 5 rounds).
+# At flagship scale (1000 clients x ResNet-18, lr 0.005): 368 c*r/s
+# (1.10x pod-rate, the per-step vote is the system's highest-frequency
+# sync) and 0.6486@150 rounds still climbing — the 1-bit vote's genuine
+# convergence cost (docs/PERFORMANCE.md round 5).
 python -m distributed_learning_simulator_tpu.simulator \
   --dataset_name mnist --model_name lenet5 \
   --distributed_algorithm sign_SGD \
