@@ -39,6 +39,7 @@ import numpy as np
 
 from distributed_learning_simulator_tpu.algorithms.base import RoundContext
 from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
+from distributed_learning_simulator_tpu.utils.errors import is_device_oom
 from distributed_learning_simulator_tpu.ops.aggregate import (
     subset_masks_all,
     subset_weighted_mean,
@@ -159,18 +160,47 @@ class _SubsetEvaluator:
         xb, yb, mb = eval_batches
         size = self._chunk
         pending = []
-        for start in range(0, len(masks), size):
-            chunk = masks[start : start + size]
-            pad = size - len(chunk)
-            if pad:
-                chunk = np.concatenate(
-                    [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
+        try:
+            for start in range(0, len(masks), size):
+                chunk = masks[start : start + size]
+                pad = size - len(chunk)
+                if pad:
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
+                    )
+                vals = self._eval_chunk(
+                    client_params, sizes, jnp.asarray(chunk), prev_global,
+                    xb, yb, mb,
                 )
-            vals = self._eval_chunk(
-                client_params, sizes, jnp.asarray(chunk), prev_global, xb, yb, mb
+                pending.append(vals[: size - pad] if pad else vals)
+            return np.concatenate(jax.device_get(pending))
+        except jax.errors.JaxRuntimeError as e:
+            if not is_device_oom(e):
+                raise
+            # Same actionable-hint treatment as the simulator's round-level
+            # _oom_hint: the evaluator's envelope is chunk subset models x
+            # eval-batch activations resident at once (measured: the
+            # full-10k-sample set at chunk 64 exceeds one chip on cnn_tpu
+            # while chunk 16 fits — docs/PERFORMANCE.md § Scale
+            # validation).
+            n_eval = int(xb.shape[0]) * int(xb.shape[1])
+            suggestion = max(size // 4, 1)
+            chunk_advice = (
+                f"Lower shapley_eval_chunk (e.g. {suggestion}) or cap "
+                if suggestion < size
+                # Mirrors _oom_hint's exceeded-even-at-minimum branch: at
+                # chunk <= 4 a quartered suggestion is a no-op, so the
+                # only lever left is the eval-sample cap.
+                else f"shapley_eval_chunk={size} is already minimal — cap "
             )
-            pending.append(vals[: size - pad] if pad else vals)
-        return np.concatenate(jax.device_get(pending))
+            raise RuntimeError(
+                "device OOM inside the Shapley subset evaluator: "
+                f"shapley_eval_chunk={size} subset models x ~{n_eval} "
+                "eval samples of activations were resident at once. "
+                + chunk_advice +
+                "shapley_eval_samples (subset utilities only; the "
+                "round metric keeps the full test set)."
+            ) from e
 
 
 def _check_shapley_config(config) -> None:

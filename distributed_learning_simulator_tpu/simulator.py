@@ -52,6 +52,7 @@ from distributed_learning_simulator_tpu.parallel.mesh import (
     replicate,
     shard_client_data,
 )
+from distributed_learning_simulator_tpu.utils.errors import is_device_oom
 from distributed_learning_simulator_tpu.utils.checkpoint import (
     latest_checkpoint,
     load_checkpoint,
@@ -185,7 +186,7 @@ def _oom_hint(config, global_params, n_clients: int, site: str = "round"):
     try:
         yield
     except jax.errors.JaxRuntimeError as e:
-        if "out of memory" not in str(e).lower():
+        if not is_device_oom(e):
             raise
         # In-flight clients = chunk bounded by the sampled cohort size.
         cohort = config.cohort_size(n_clients)

@@ -1,0 +1,14 @@
+"""Shared error classification helpers."""
+
+from __future__ import annotations
+
+
+def is_device_oom(e: Exception) -> bool:
+    """True when a JaxRuntimeError is a device RESOURCE_EXHAUSTED OOM.
+
+    THE one copy of the message-form classifier, shared by the
+    simulator's round-level ``_oom_hint`` and the Shapley subset
+    evaluator's hint — if a jax/XLA upgrade changes the message, one
+    fix covers every sized-hint site.
+    """
+    return "out of memory" in str(e).lower()

@@ -308,3 +308,58 @@ def test_gtg_convergence_respects_converge_min(tiny_config):
     records = [np.ones(2)] * 30  # perfectly flat, but too few samples
     assert algo._converged(records, n=2) is False
     assert algo._converged(records + [np.ones(2)], n=2) is True
+
+
+def test_subset_evaluator_oom_hint(tiny_config):
+    """A device OOM inside the subset evaluator must re-raise with the
+    actionable knobs (shapley_eval_chunk / shapley_eval_samples) named —
+    the same sized-hint treatment the simulator's round-level OOMs get.
+    Non-OOM runtime errors must pass through untouched."""
+    import jax
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        _SubsetEvaluator,
+    )
+
+    ev = _SubsetEvaluator(lambda *a: {"accuracy": 0.0}, chunk=8)
+    masks = np.ones((4, 3), np.float32)
+    batches = (np.zeros((2, 4, 2)), np.zeros((2, 4), np.int32),
+               np.ones((2, 4)))
+
+    def boom(*a, **k):
+        raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    ev._eval_chunk = boom
+    with pytest.raises(RuntimeError, match="shapley_eval_chunk"):
+        ev(None, None, masks, None, batches)
+
+    def other(*a, **k):
+        raise jax.errors.JaxRuntimeError("INTERNAL: something else")
+
+    ev._eval_chunk = other
+    with pytest.raises(jax.errors.JaxRuntimeError, match="something else"):
+        ev(None, None, masks, None, batches)
+
+
+def test_subset_evaluator_oom_hint_minimal_chunk():
+    """At an already-minimal chunk the hint must not suggest the same
+    chunk back — it points at the eval-sample cap instead."""
+    import jax
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        _SubsetEvaluator,
+    )
+
+    ev = _SubsetEvaluator(lambda *a: {"accuracy": 0.0}, chunk=1)
+
+    def boom(*a, **k):
+        raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    ev._eval_chunk = boom
+    masks = np.ones((2, 3), np.float32)
+    batches = (np.zeros((1, 4, 2)), np.zeros((1, 4), np.int32),
+               np.ones((1, 4)))
+    with pytest.raises(RuntimeError, match="already minimal"):
+        ev(None, None, masks, None, batches)
