@@ -25,6 +25,15 @@ Reference defects fixed, not replicated:
     semantics at a fraction of its N-sequential-host-round-trips cost.
     ``metric_<round>.pkl`` therefore holds only the prefixes actually
     evaluated (as the reference's lazy walk does), not every prefix.
+  * GTG prefix AGGREGATION is cumulative (``gtg_prefix_mode='cumsum'``,
+    the default): a permutation's prefix models come from one streamed
+    weighted cumulative sum over its clients in walk order
+    (ops/aggregate.block_prefix_cumsum via _CumsumPrefixWalker), so a
+    length-L walk moves O(L*P) HBM bytes where the per-prefix masked
+    reduction moved O(L*N*P/chunk) — the N-fold structural win at the
+    north-star N=1000 (docs/PERFORMANCE.md § GTG at scale).
+    ``gtg_prefix_mode='masked'`` keeps the mask-weighted path as the
+    differential-testing oracle.
 """
 
 from __future__ import annotations
@@ -41,6 +50,8 @@ from distributed_learning_simulator_tpu.algorithms.base import RoundContext
 from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
 from distributed_learning_simulator_tpu.utils.errors import is_device_oom
 from distributed_learning_simulator_tpu.ops.aggregate import (
+    block_prefix_cumsum,
+    prefix_means_from_cumsum,
     subset_masks_all,
     subset_weighted_mean,
 )
@@ -48,6 +59,16 @@ from distributed_learning_simulator_tpu.utils.logging import get_logger
 
 _EVAL_CHUNK = 16  # subset models evaluated per batched XLA call
 _PREFIX_BLOCK = 16  # GTG permutation prefixes fetched per fused call
+
+
+def _resolve_eval_dtype(config, default: str) -> str:
+    """Per-algorithm ``shapley_eval_dtype='auto'`` resolution (ADVICE r5):
+    exact multi-round Shapley reads the stack in f32 — it is the documented
+    exact-parity path with no Monte-Carlo noise to hide bf16 rounding in —
+    while GTG keeps bf16, where halving the dominant stack-read traffic is
+    measured fidelity-free. An explicit config value wins for both."""
+    dtype = getattr(config, "shapley_eval_dtype", "auto")
+    return default if dtype == "auto" else dtype
 
 
 def shapley_from_utilities(utilities: dict[frozenset, float], n: int) -> np.ndarray:
@@ -134,6 +155,76 @@ class _SubsetEvaluator:
             jax.vmap(eval_one, in_axes=(None, None, 0, None, None, None, None))
         )
 
+        # GTG cumsum path (gtg_prefix_mode='cumsum'): ONE fused XLA call per
+        # group of G permutations advances their walks by a whole prefix
+        # block — gather the block's clients, extend the carried running
+        # sums (block_prefix_cumsum), materialize the G*B prefix models by a
+        # cheap divide, and evaluate them — so each evaluated prefix reads
+        # O(P) gathered bytes instead of the masked path's O(N*P/chunk)
+        # stack re-read, and the C*N*P mask-contraction MACs per call
+        # disappear outright. ``carry``/``carry_t`` hold exactly this
+        # group's G running sums ([G, ...] leaves — the walker compacts the
+        # wave's active rows host-side), so a call's carry traffic is
+        # O(G*P), an eighth of the block models it evaluates; a
+        # whole-cohort slot array with scatter updates was measured 6x
+        # SLOWER than the masked path on backends without in-place buffer
+        # donation (each call copied all N carries).
+        def prefix_wave(client_params, sizes, carry, carry_t, perm_block,
+                        prev_global, xb, yb, mb):
+            cs_tree, totals = block_prefix_cumsum(
+                client_params, sizes, perm_block, carry, carry_t,
+            )
+            new_carry = jax.tree_util.tree_map(
+                lambda cs: cs[:, -1], cs_tree
+            )
+            params = prefix_means_from_cumsum(cs_tree, totals, prev_global)
+            g, b = perm_block.shape
+            flat = jax.tree_util.tree_map(
+                lambda p: p.reshape((g * b,) + p.shape[2:]), params
+            )
+            accs = jax.vmap(
+                lambda pp: eval_fn(pp, xb, yb, mb)["accuracy"]
+            )(flat)
+            return accs.reshape(g, b), new_carry, totals[:, -1]
+
+        self._prefix_wave = jax.jit(prefix_wave)
+
+    @property
+    def eval_dtype(self):
+        return self._eval_dtype
+
+    def _reraise_oom(self, e, n_models: int, eval_batches,
+                     min_chunk: int = 1):
+        """Shared actionable-hint treatment for device OOMs in both the
+        masked-chunk and cumsum prefix-wave paths: the envelope is
+        ``n_models`` subset models x eval-batch activations resident at
+        once (measured: the full-10k-sample set at chunk 64 exceeds one
+        chip on cnn_tpu while chunk 16 fits — docs/PERFORMANCE.md § Scale
+        validation). ``min_chunk`` is the path's floor on the call width:
+        the cumsum prefix wave cannot go below one block of
+        ``_PREFIX_BLOCK`` models, so suggesting a smaller chunk there
+        would send the user into the identical crash."""
+        xb = eval_batches[0]
+        n_eval = int(xb.shape[0]) * int(xb.shape[1])
+        suggestion = max(self._chunk // 4, min_chunk)
+        chunk_advice = (
+            f"Lower shapley_eval_chunk (e.g. {suggestion}) or cap "
+            if suggestion < self._chunk
+            # Mirrors _oom_hint's exceeded-even-at-minimum branch: when a
+            # smaller chunk cannot shrink the call (chunk <= 4 on the
+            # masked path, chunk <= one prefix block on the cumsum path),
+            # the only lever left is the eval-sample cap.
+            else f"shapley_eval_chunk={self._chunk} is already minimal — cap "
+        )
+        raise RuntimeError(
+            "device OOM inside the Shapley subset evaluator: "
+            f"{n_models} subset models x ~{n_eval} "
+            "eval samples of activations were resident at once. "
+            + chunk_advice +
+            "shapley_eval_samples (subset utilities only; the "
+            "round metric keeps the full test set)."
+        ) from e
+
     def prepare_stack(self, client_params):
         """Cast the [n_clients, ...] stack to the evaluator read dtype ONCE
         per round (config.shapley_eval_dtype). Each batched call re-reads
@@ -177,30 +268,140 @@ class _SubsetEvaluator:
         except jax.errors.JaxRuntimeError as e:
             if not is_device_oom(e):
                 raise
-            # Same actionable-hint treatment as the simulator's round-level
-            # _oom_hint: the evaluator's envelope is chunk subset models x
-            # eval-batch activations resident at once (measured: the
-            # full-10k-sample set at chunk 64 exceeds one chip on cnn_tpu
-            # while chunk 16 fits — docs/PERFORMANCE.md § Scale
-            # validation).
-            n_eval = int(xb.shape[0]) * int(xb.shape[1])
-            suggestion = max(size // 4, 1)
-            chunk_advice = (
-                f"Lower shapley_eval_chunk (e.g. {suggestion}) or cap "
-                if suggestion < size
-                # Mirrors _oom_hint's exceeded-even-at-minimum branch: at
-                # chunk <= 4 a quartered suggestion is a no-op, so the
-                # only lever left is the eval-sample cap.
-                else f"shapley_eval_chunk={size} is already minimal — cap "
+            self._reraise_oom(e, size, eval_batches)
+
+
+class _CumsumPrefixWalker:
+    """Device-side state of one GTG sampling iteration's permutation walks
+    under ``gtg_prefix_mode='cumsum'``.
+
+    Per active permutation, a carry row holds the f32 running weighted sum
+    (and total weight) of the walked prefix — compacted each wave to just
+    the still-active walks; :meth:`eval_block` advances a wave of them by
+    one prefix block, batching ``group`` permutations' block-cumsums per
+    fused evaluator call (this replaces the masked path's ``_PREFIX_BLOCK``
+    wave gather: same wave-major structure, same single fetch per wave, but
+    each evaluated prefix costs O(P) gathered bytes instead of an
+    O(N*P/chunk) share of a full stack re-read). Nothing is ever
+    recomputed: the carry IS the sliceable cumsum, streamed block by block,
+    and an eps-truncated walk simply never touches the blocks past its
+    stopping point.
+
+    Bookkeeping parity with the masked path: the same prefix sets land in
+    the memo (memo-first on duplicates, so a set evaluated twice — e.g. the
+    grand coalition, reached by every full-length walk — keeps one
+    deterministic value), so ``metric_<round>.pkl`` and the walk's
+    truncation/marginal decisions see identical keys. Device-side work may
+    exceed the masked path's on memo HITS (a hit still computes inside the
+    fused call and is discarded host-side); at large N a walk re-visits
+    almost no sets, so the waste is a handful of inferences per iteration.
+    """
+
+    def __init__(self, evaluator, client_params, sizes, prev_global,
+                 eval_batches, n: int):
+        self._ev = evaluator
+        self._stack = client_params
+        self._sizes = sizes
+        self._prev_global = prev_global
+        self._eval_batches = eval_batches
+        self._n = n
+        self._block = min(_PREFIX_BLOCK, n)
+        # Group size: the fused call evaluates group x block prefix models,
+        # so group*block matches the masked path's shapley_eval_chunk
+        # activation envelope (floor one group — cumsum mode's minimum call
+        # width is one block of models).
+        self._group = max(1, evaluator._chunk // self._block)
+        self._carry = None
+        self._carry_t = None
+        self._row_of: dict[int, int] = {}
+
+    def reset(self):
+        """Drop the carries for a fresh sampling iteration (every walk
+        restarts at the empty prefix — materialized lazily as zero rows on
+        the first wave)."""
+        self._carry = None
+        self._carry_t = None
+        self._row_of = {}
+
+    def _wave_carries(self, active):
+        """Compact the carry rows of this wave's active permutations into
+        one contiguous [ceil(A/G)*G, ...] tree (row k = active[k]; the tail
+        pads by repeating a row so every group slice is exactly [G, ...] —
+        one traced shape, garbage results discarded host-side). ONE gather
+        per wave: truncated permutations' rows are dropped here, which is
+        all the 'slicing' an eps-truncated walk ever needs — its cumsum
+        simply stops being carried, nothing is recomputed."""
+        g_size = self._group
+        padded = -(-len(active) // g_size) * g_size
+        if self._carry is None:  # first wave: every carry is the empty sum
+            carry = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((padded,) + x.shape[1:], jnp.float32),
+                self._stack,
             )
-            raise RuntimeError(
-                "device OOM inside the Shapley subset evaluator: "
-                f"shapley_eval_chunk={size} subset models x ~{n_eval} "
-                "eval samples of activations were resident at once. "
-                + chunk_advice +
-                "shapley_eval_samples (subset utilities only; the "
-                "round metric keeps the full test set)."
-            ) from e
+            return carry, jnp.zeros((padded,), jnp.float32)
+        rows = np.asarray(
+            [self._row_of[p] for p in active], dtype=np.int32
+        )
+        rows = np.concatenate(
+            [rows, np.full((padded - len(rows),), rows[-1], np.int32)]
+        )
+        return (
+            jax.tree_util.tree_map(lambda c: c[rows], self._carry),
+            self._carry_t[rows],
+        )
+
+    def eval_block(self, perms, active, j0: int, j1: int, memo) -> None:
+        """Advance every permutation in ``active`` through prefix positions
+        [j0, j1), filling ``memo`` with the block's utilities. All groups
+        are dispatched first and fetched with ONE device_get (the same
+        tunnel-latency discipline as the masked evaluator)."""
+        g_size, b_size = self._group, self._block
+        carry, carry_t = self._wave_carries(active)
+        pending = []
+        new_carries = []
+        try:
+            for start in range(0, len(active), g_size):
+                group = active[start : start + g_size]
+                # A short final block (j1 - j0 < block) pads its trailing
+                # positions with client 0 — that corrupts the carry past
+                # position n-1, which no later block exists to read.
+                block = np.zeros((g_size, b_size), np.int32)
+                for g, p in enumerate(group):
+                    block[g, : j1 - j0] = perms[p][j0:j1]
+                c_g = jax.tree_util.tree_map(
+                    lambda c: c[start : start + g_size], carry
+                )
+                accs, nc, nct = self._ev._prefix_wave(
+                    self._stack, self._sizes, c_g,
+                    carry_t[start : start + g_size],
+                    jnp.asarray(block), self._prev_global,
+                    *self._eval_batches,
+                )
+                pending.append((group, accs))
+                new_carries.append((nc, nct))
+            fetched = jax.device_get([a for _, a in pending])
+        except jax.errors.JaxRuntimeError as e:
+            if not is_device_oom(e):
+                raise
+            self._ev._reraise_oom(
+                e, g_size * b_size, self._eval_batches, min_chunk=b_size,
+            )
+        if len(new_carries) == 1:
+            self._carry, self._carry_t = new_carries[0]
+        else:
+            self._carry = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs),
+                *[nc for nc, _ in new_carries],
+            )
+            self._carry_t = jnp.concatenate([t for _, t in new_carries])
+        self._row_of = {p: k for k, p in enumerate(active)}
+        for (group, _), acc in zip(pending, fetched):
+            for g, p in enumerate(group):
+                perm = perms[p]
+                for b in range(j1 - j0):
+                    s = frozenset(perm[: j0 + b + 1])
+                    if s not in memo:
+                        memo[s] = float(acc[g, b])
 
 
 def _check_shapley_config(config) -> None:
@@ -271,7 +472,7 @@ class MultiRoundShapley(FedAvg):
         self._evaluator = _SubsetEvaluator(
             eval_fn,
             chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
-            eval_dtype=getattr(self.config, "shapley_eval_dtype", "float32"),
+            eval_dtype=_resolve_eval_dtype(self.config, default="float32"),
         )
 
     def post_round(self, ctx: RoundContext) -> dict:
@@ -418,7 +619,7 @@ class GTGShapley(FedAvg):
         self._evaluator = _SubsetEvaluator(
             eval_fn,
             chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
-            eval_dtype=getattr(self.config, "shapley_eval_dtype", "float32"),
+            eval_dtype=_resolve_eval_dtype(self.config, default="bfloat16"),
         )
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
@@ -498,9 +699,16 @@ class GTGShapley(FedAvg):
         # subset utilities come from a SUBSAMPLED estimator whose grand-
         # coalition value differs from the full-set round metric by
         # subsample noise >> eps — comparing across estimators would make
-        # truncation fire never (or spuriously). Use the grand-coalition
-        # utility from the SAME estimator as the walked prefixes.
-        if getattr(self.config, "shapley_eval_samples", None) is not None:
+        # truncation fire never (or spuriously). The same cross-estimator
+        # mismatch exists when the evaluator reads a non-f32 stack (ADVICE
+        # r5): the bf16 estimator's grand-coalition utility sits bf16
+        # rounding (~1e-3, the scale of eps itself) away from the f32
+        # round metric. In either case use the grand-coalition utility
+        # from the SAME estimator as the walked prefixes.
+        if (
+            getattr(self.config, "shapley_eval_samples", None) is not None
+            or self._evaluator.eval_dtype != jnp.float32
+        ):
             grand = frozenset(range(n))
             utilities_for([grand])
             trunc_ref = memo[grand]
@@ -515,6 +723,22 @@ class GTGShapley(FedAvg):
                 "gtg_max_permutations=%d < N=%d: the first sampling "
                 "iteration alone draws N permutations; the cap will be "
                 "exceeded and convergence cannot fire", cap, n,
+            )
+        # Prefix-aggregation mode (config.gtg_prefix_mode): 'cumsum' (the
+        # default) streams each permutation's weighted running sum block by
+        # block and takes every prefix model from an O(P) slice of it;
+        # 'masked' is the original per-prefix mask-weighted reduction over
+        # the full stack, kept as the bit-level oracle
+        # (tests/test_shapley.py::test_gtg_prefix_mode_equivalence). Both
+        # modes share the RNG stream, the wave structure, the memo, and the
+        # truncation/marginal bookkeeping below, so a fixed seed yields the
+        # same permutations and — utilities agreeing — identical records.
+        mode = getattr(self.config, "gtg_prefix_mode", "cumsum")
+        walker = None
+        if mode == "cumsum":
+            walker = _CumsumPrefixWalker(
+                self._evaluator, client_params, ctx.sizes,
+                ctx.prev_global_params, eval_batches, n,
             )
         records: list[np.ndarray] = []
         n_perms = 0
@@ -543,27 +767,32 @@ class GTGShapley(FedAvg):
                 rest = [i for i in range(n) if i != first]
                 self._rng.shuffle(rest)
                 perms.append([first] + rest)
+            if walker is not None:
+                walker.reset()  # fresh zero carries for this iteration
             marginals = np.zeros((n, n), dtype=np.float64)
             v_prev = [memo[frozenset()]] * n
             truncated = [False] * n
             for j0 in range(0, n, _PREFIX_BLOCK):
                 j1 = min(j0 + _PREFIX_BLOCK, n)
-                wave: list[frozenset] = []
-                for p_idx, perm in enumerate(perms):
+                active: list[int] = []
+                for p_idx in range(n):
                     if truncated[p_idx] or (
                         abs(trunc_ref - v_prev[p_idx]) < self.eps
                     ):
                         truncated[p_idx] = True
-                        continue
-                    wave.extend(
-                        frozenset(perm[: j + 1]) for j in range(j0, j1)
-                    )
-                if not wave:
+                    else:
+                        active.append(p_idx)
+                if not active:
                     break  # every permutation truncated
-                utilities_for(wave)
-                for p_idx, perm in enumerate(perms):
-                    if truncated[p_idx]:
-                        continue
+                if walker is not None:
+                    walker.eval_block(perms, active, j0, j1, memo)
+                else:
+                    utilities_for([
+                        frozenset(perms[p][: j + 1])
+                        for p in active for j in range(j0, j1)
+                    ])
+                for p_idx in active:
+                    perm = perms[p_idx]
                     vp = v_prev[p_idx]
                     for j in range(j0, j1):
                         if abs(trunc_ref - vp) >= self.eps:
@@ -597,4 +826,8 @@ class GTGShapley(FedAvg):
             "shapley_values": sv,
             "gtg_permutations": n_perms,
             "gtg_subset_evals": len(memo),
+            # Tracked by bench.py's gtg leg / scripts/measure_gtg_scale.py:
+            # a converged round is the honest cost unit (a fixed-budget
+            # Monte-Carlo round is cheaper but a different estimator).
+            "gtg_converged": converged,
         }

@@ -151,14 +151,30 @@ class ExperimentConfig:
     # resident at once).
     shapley_eval_chunk: int = 16
     # Dtype the subset evaluator reads the client-params stack in.
-    # "bfloat16" (default) halves the per-call stack read — the dominant
-    # HBM traffic of a large-N GTG round — while the subset weighted mean
-    # still ACCUMULATES in f32 (tensordot preferred_element_type, the
-    # MXU's native bf16-in/f32-out mode) and the produced subset model is
-    # f32. Utilities feed an argmax accuracy, so the measured SV
-    # perturbation vs "float32" is below Monte-Carlo noise
-    # (tests/test_shapley.py::test_shapley_eval_dtype_agreement).
-    shapley_eval_dtype: str = "bfloat16"
+    # "auto" (default) resolves per algorithm (ADVICE r5): "float32" for
+    # multiround_shapley_value — the documented exact-parity path, with no
+    # Monte-Carlo noise to hide bf16 rounding in — and "bfloat16" for
+    # GTG_shapley_value, where halving the per-call stack read (the
+    # dominant HBM traffic of a large-N round) is measured fidelity-free.
+    # Either aggregation path still ACCUMULATES in f32 (tensordot
+    # preferred_element_type / f32 cumulative sums) and the produced
+    # subset model is f32. Utilities feed an argmax accuracy, so the
+    # measured GTG SV perturbation vs "float32" is below Monte-Carlo noise
+    # (tests/test_shapley.py::test_shapley_eval_dtype_agreement). An
+    # explicit "float32"/"bfloat16" wins for both algorithms.
+    shapley_eval_dtype: str = "auto"
+    # How GTG materializes a permutation's prefix models
+    # (algorithms/shapley.py): "cumsum" (default) gathers each
+    # permutation's clients once in walk order and takes every prefix
+    # aggregate from one streamed weighted cumulative sum — O(P) HBM bytes
+    # per evaluated prefix instead of the masked path's O(N*P/chunk) share
+    # of a full client-stack re-read — with the cross-permutation memo and
+    # eps-truncation semantics intact (a truncated walk just stops
+    # streaming; nothing is recomputed). "masked" keeps the per-prefix
+    # mask-weighted reduction as the differential-testing oracle; the two
+    # modes draw identical permutations from a fixed seed and agree
+    # exactly in f32 (tests/test_shapley.py).
+    gtg_prefix_mode: str = "cumsum"
 
     # --- execution ----------------------------------------------------------
     # "vmap": the fast path — one jitted round program over the client axis.
@@ -342,10 +358,20 @@ class ExperimentConfig:
             raise ValueError("shapley_eval_samples must be >= 1 or None")
         if self.shapley_eval_chunk < 1:
             raise ValueError("shapley_eval_chunk must be >= 1")
-        if self.shapley_eval_dtype not in ("float32", "bfloat16"):
+        if self.shapley_eval_dtype not in ("auto", "float32", "bfloat16"):
             raise ValueError(
-                "shapley_eval_dtype must be 'float32' or 'bfloat16', got "
-                f"{self.shapley_eval_dtype!r}"
+                "shapley_eval_dtype must be 'auto', 'float32' or "
+                f"'bfloat16', got {self.shapley_eval_dtype!r}"
+            )
+        if self.gtg_prefix_mode not in ("cumsum", "masked"):
+            raise ValueError(
+                "gtg_prefix_mode must be 'cumsum' or 'masked', got "
+                f"{self.gtg_prefix_mode!r}"
+            )
+        if self.profile_from_round < 0:
+            raise ValueError(
+                f"profile_from_round must be >= 0, got "
+                f"{self.profile_from_round}"
             )
         if (
             self.gtg_max_permutations is not None

@@ -51,6 +51,15 @@ import jax.numpy as jnp
 from distributed_learning_simulator_tpu.ops.gn_pallas import pallas_group_norm
 
 
+# Read ONCE at import (ADVICE r5): the flag selects which GroupNorm forward
+# gets COMPILED into the round program, so flipping the env var after the
+# first compile could not take effect anyway — the jit cache would keep
+# serving the stale path silently. A module constant makes the
+# first-read-wins semantics explicit; in-process tests that genuinely need
+# both kernels toggle the constant itself (test_folded_resnet.py).
+_GN_PALLAS_ENABLED = os.environ.get("DLS_GN_PALLAS", "0") == "1"
+
+
 def _use_pallas_gn() -> bool:
     """Opt-in Pallas GroupNorm forward (``DLS_GN_PALLAS=1``, TPU only).
 
@@ -66,7 +75,7 @@ def _use_pallas_gn() -> bool:
     bytes. Third structural attack on the stage-1 f32 sharing (after
     HWNC orientation and optimization_barrier, module docstring), third
     in-context rejection — the jnp path stands as the measured floor."""
-    if os.environ.get("DLS_GN_PALLAS", "0") != "1":
+    if not _GN_PALLAS_ENABLED:
         return False
     try:
         return jax.default_backend() == "tpu"

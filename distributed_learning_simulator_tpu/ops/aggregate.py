@@ -62,14 +62,76 @@ def subset_weighted_mean(stacked_tree, weights, mask, fallback_tree):
     def _leaf(x, fb):
         # preferred_element_type: accumulate in f32 even when the stack is
         # read in bf16 (shapley_eval_dtype) — the MXU's native
-        # bf16-in/f32-out contraction; a no-op for f32 stacks.
+        # bf16-in/f32-out contraction; a no-op for f32 stacks. The weight
+        # vector itself stays f32 (ADVICE r5): tensordot handles the mixed
+        # operand dtypes, the vector is tiny, and rounding the normalized
+        # weights to bf16 would perturb every coordinate of the mean.
         avg = jnp.tensordot(
-            norm.astype(x.dtype), x, axes=(0, 0),
-            preferred_element_type=jnp.float32,
+            norm, x, axes=(0, 0), preferred_element_type=jnp.float32,
         )
         return jnp.where(nonempty, avg, fb.astype(avg.dtype))
 
     return jax.tree_util.tree_map(_leaf, stacked_tree, fallback_tree)
+
+
+def block_prefix_cumsum(stacked_tree, weights, perm_block,
+                        carry_tree=None, carry_total=None):
+    """Weighted running sums over a block of permutation positions.
+
+    The GTG-Shapley cumsum path (``gtg_prefix_mode='cumsum'``): instead of
+    one mask-weighted reduction over the FULL ``[n_clients, ...]`` stack per
+    permutation prefix (O(N*P) bytes each, O(N^2*P) per walk), gather only
+    the block's clients in permutation order and extend a running weighted
+    sum — every prefix aggregate of the walk costs O(P) gathered bytes, and
+    an eps-truncated walk never touches the clients past its stopping block.
+
+    ``perm_block`` is ``[G, B]`` int32 client ids: for each of G
+    permutations, the clients at walk positions ``[j0, j0+B)``.
+    ``carry_tree`` / ``carry_total`` (leaves ``[G, ...]`` / ``[G]``, f32)
+    hold the running sums over positions ``[0, j0)``; None = the block
+    starts the walk. Returns ``(cs_tree, totals)`` with leaves
+    ``[G, B, ...]`` / ``[G, B]`` in f32 — accumulation is f32 regardless of
+    the stack dtype (a bf16 running sum over hundreds of clients would
+    swallow the small late terms) — where ``cs_tree[g, b]`` is
+    ``sum_{k <= j0+b} w[perm_g[k]] * x[perm_g[k]]``.
+    """
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    w = weights[perm_block]  # [G, B]
+    totals = jnp.cumsum(w, axis=1)
+    if carry_total is not None:
+        totals = totals + carry_total[:, None]
+
+    def _leaf(x, c):
+        xg = x[perm_block].astype(jnp.float32)  # [G, B, ...] gather
+        wexp = w.reshape(w.shape + (1,) * (x.ndim - 1))
+        cs = jnp.cumsum(xg * wexp, axis=1)
+        if c is not None:
+            cs = cs + c[:, None]
+        return cs
+
+    if carry_tree is None:
+        cs_tree = jax.tree_util.tree_map(lambda x: _leaf(x, None), stacked_tree)
+    else:
+        cs_tree = jax.tree_util.tree_map(_leaf, stacked_tree, carry_tree)
+    return cs_tree, totals
+
+
+def prefix_means_from_cumsum(cs_tree, totals, fallback_tree):
+    """Prefix aggregates from running sums: ``cs / total`` where the prefix
+    carries weight, the fallback model (previous global params) where it
+    does not — the same zero-weight semantics as
+    :func:`subset_weighted_mean`'s empty-subset branch. Leaves come back
+    ``[G, B, ...]`` f32, matching the masked path's f32 subset models.
+    """
+    nonempty = totals > 0
+    safe = jnp.where(nonempty, totals, 1.0)
+
+    def _leaf(cs, fb):
+        shape = totals.shape + (1,) * (cs.ndim - 2)
+        avg = cs / safe.reshape(shape)
+        return jnp.where(nonempty.reshape(shape), avg, fb.astype(avg.dtype))
+
+    return jax.tree_util.tree_map(_leaf, cs_tree, fallback_tree)
 
 
 def coordinate_median(stacked_tree, weights=None):

@@ -156,12 +156,32 @@ def _assert_client_stack_feasible(config, global_params, n_clients: int):
     # trains n_participants clients), so that is what must fit.
     cohort = config.cohort_size(n_clients)
     stack_bytes = cohort * param_bytes
+    # GTG's cumsum prefix walk (gtg_prefix_mode='cumsum') additionally
+    # carries one f32 running-sum row per still-active permutation. Worst
+    # case THREE stack-sized carry trees coexist at a wave boundary (the
+    # previous wave's carry, its compacted gather, and the re-concatenated
+    # outputs — _CumsumPrefixWalker.eval_block), so budget the stack 3x
+    # over — reported as its own term so the message's arithmetic is the
+    # arithmetic checked: the whole point of this check is a clear,
+    # size-your-config-from-it refusal instead of a generic OOM mid-walk.
+    carry_note = ""
+    total_bytes = stack_bytes
+    if (
+        config.distributed_algorithm == "GTG_shapley_value"
+        and getattr(config, "gtg_prefix_mode", "cumsum") == "cumsum"
+    ):
+        total_bytes = 3 * stack_bytes
+        carry_note = (
+            " plus up to 2 stack-sized cumsum-walk carry trees = "
+            f"{total_bytes / 2**30:.1f} GB peak"
+        )
     budget = _device_budget_bytes(config)
-    if stack_bytes > budget:
+    if total_bytes > budget:
         raise ValueError(
             f"{config.distributed_algorithm!r} materializes the per-client "
             f"parameter stack: {cohort} clients x "
-            f"{param_bytes / 2**20:.0f} MB = {stack_bytes / 2**30:.1f} GB, "
+            f"{param_bytes / 2**20:.0f} MB = {stack_bytes / 2**30:.1f} GB"
+            f"{carry_note}, "
             f"over the ~{budget / 2**30:.1f} GB device budget "
             f"({config.mesh_devices or 1} device(s)). Use fewer clients, a "
             "smaller model, or more mesh_devices."

@@ -1,0 +1,37 @@
+"""Shared construction of tracked benchmark records.
+
+One definition of each tracked metric's shape, so the driver-facing
+emitters (bench.py sub-objects, scripts/* JSON lines) cannot drift into
+reporting incomparable numbers for the same cost unit.
+"""
+
+from __future__ import annotations
+
+
+def gtg_round_record(history, **extra):
+    """The tracked converged-GTG round-cost record (``gtg_round_seconds``),
+    shared by bench.py's ``gtg`` sub-object and
+    scripts/measure_gtg_scale.py.
+
+    Rounds whose walk never ran (round-truncated:
+    ``gtg_permutations == 0``) are not comparable cost points, so the
+    record reports the LAST full walk — a converged round is the honest
+    cost unit; ``converged`` says whether this one was. Falls back to the
+    final round when every round truncated (still inspectable), and
+    returns None for an empty history. ``extra`` keys (knobs, peak HBM)
+    are merged into the record.
+    """
+    if not history:
+        return None
+    walked = [h for h in history if h.get("gtg_permutations")]
+    h = walked[-1] if walked else history[-1]
+    record = {
+        "metric": "gtg_round_seconds",
+        "value": round(h["round_seconds"], 1),
+        "round": h["round"],
+        "converged": bool(h.get("gtg_converged")),
+        "permutations": h.get("gtg_permutations"),
+        "subset_evals": h.get("gtg_subset_evals"),
+    }
+    record.update(extra)
+    return record

@@ -5,17 +5,26 @@ permutations per round, subset evaluations, and peak HBM. Run on the real
 chip:
 
     python scripts/measure_gtg_scale.py [rounds] [eval_samples] [eval_chunk] \
-        [max_permutations] [eval_dtype]
+        [max_permutations] [eval_dtype] [prefix_mode]
 
 (eval_chunk default 64 — the chunk-16-vs-64 comparison in
 docs/PERFORMANCE.md § Scale validation is reproduced by passing 16/64.
 max_permutations 0 = auto cap max(500, 2N); pass 1000 to reproduce the
 round-4 one-iteration fixed-budget measurement. eval_dtype default
-bfloat16 = config default; pass float32 for the r4 configuration.)
+bfloat16 = the resolved GTG default; pass float32 for the r4
+configuration. prefix_mode default cumsum = config default; pass masked
+for the pre-round-6 per-prefix aggregation path — the cumsum-vs-masked
+before/after in docs/PERFORMANCE.md § GTG at scale is this script run
+twice.)
+
+The last line is ONE JSON record tracking the converged-GTG round cost —
+the wall-clock of the final non-round-truncated round (round 0 carries the
+XLA compile, so prefer rounds >= 2 and read the steady-state value).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -31,6 +40,7 @@ def main():
     eval_chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 64
     max_perms = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     eval_dtype = sys.argv[5] if len(sys.argv) > 5 else "bfloat16"
+    prefix_mode = sys.argv[6] if len(sys.argv) > 6 else "cumsum"
 
     from distributed_learning_simulator_tpu.config import ExperimentConfig
     from distributed_learning_simulator_tpu.simulator import run_simulation
@@ -42,7 +52,7 @@ def main():
         batch_size=25, client_chunk_size=250, eval_batch_size=10000,
         shapley_eval_samples=eval_samples, shapley_eval_chunk=eval_chunk,
         gtg_max_permutations=max_perms or None,
-        shapley_eval_dtype=eval_dtype,
+        shapley_eval_dtype=eval_dtype, gtg_prefix_mode=prefix_mode,
         log_level="INFO",
     )
     t0 = time.perf_counter()
@@ -52,9 +62,12 @@ def main():
         print(
             f"round {h['round']}: {h['round_seconds']:.1f}s total, "
             f"acc={h['test_accuracy']:.4f}, "
-            f"permutations={h.get('gtg_permutations')}"
+            f"permutations={h.get('gtg_permutations')}, "
+            f"subset_evals={h.get('gtg_subset_evals')}, "
+            f"converged={h.get('gtg_converged')}"
         )
     print(f"total wall: {wall:.1f}s for {rounds} rounds")
+    peak = None
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
         peak = stats.get("peak_bytes_in_use")
@@ -64,6 +77,22 @@ def main():
             print(f"memory_stats keys: {sorted(stats)}")
     except Exception as e:  # plugin may not expose memory stats
         print(f"memory_stats unavailable: {e}")
+
+    # Tracked metric (ISSUE 1): converged-GTG round wall-clock — the same
+    # record shape bench.py's ``gtg`` sub-object emits (one shared
+    # constructor, utils/reporting.py, so the two numbers stay comparable).
+    from distributed_learning_simulator_tpu.utils.reporting import (
+        gtg_round_record,
+    )
+
+    rec = gtg_round_record(
+        result["history"],
+        clients=1000, prefix_mode=prefix_mode, eval_samples=eval_samples,
+        eval_chunk=eval_chunk, eval_dtype=eval_dtype,
+        peak_hbm_gib=round(peak / 2**30, 2) if peak else None,
+    )
+    if rec is not None:
+        print(json.dumps(rec))
 
 
 if __name__ == "__main__":

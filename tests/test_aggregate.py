@@ -68,3 +68,141 @@ def test_subset_masks_all_counts():
     assert len({tuple(row) for row in masks.astype(int)}) == 16
     no_empty = subset_masks_all(4, include_empty=False)
     assert no_empty.shape == (15, 4)
+
+
+def _prefix_mask(n, perm, j):
+    mask = np.zeros((n,), np.float32)
+    mask[perm[: j + 1]] = 1.0
+    return jnp.asarray(mask)
+
+
+def test_block_prefix_cumsum_bitwise_matches_masked():
+    """The GTG cumsum path vs the per-mask oracle, BIT-FOR-BIT in f32.
+
+    Weights double along the walk order, so every prefix total is a power
+    of two and every normalized weight a dyadic rational; with small
+    integer-valued params both paths' f32 arithmetic is exact, so they
+    must compute the identical real value — any bit difference is a real
+    defect in one of the two aggregation paths, not rounding."""
+    from distributed_learning_simulator_tpu.ops.aggregate import (
+        block_prefix_cumsum,
+        prefix_means_from_cumsum,
+    )
+
+    rng = np.random.default_rng(3)
+    n = 12
+    perm = rng.permutation(n)
+    weights = np.zeros((n,), np.float32)
+    weights[perm[0]] = 1.0
+    for k in range(1, n):
+        weights[perm[k]] = 2.0 ** (k - 1)  # prefix totals: 1, 2, 4, ...
+    tree = {
+        "w": jnp.asarray(
+            rng.integers(-8, 9, size=(n, 3, 2)).astype(np.float32)
+        ),
+        "b": jnp.asarray(rng.integers(-8, 9, size=(n, 5)).astype(np.float32)),
+    }
+    fallback = {k: jnp.zeros_like(v[0]) for k, v in tree.items()}
+    cs, totals = block_prefix_cumsum(tree, weights, perm[None, :])
+    means = prefix_means_from_cumsum(cs, totals, fallback)
+    for j in range(n):
+        oracle = subset_weighted_mean(
+            tree, weights, _prefix_mask(n, perm, j), fallback
+        )
+        for k in tree:
+            np.testing.assert_array_equal(
+                np.asarray(means[k][0, j]), np.asarray(oracle[k]), strict=True
+            )
+
+
+def test_block_prefix_cumsum_carry_continuation():
+    """Streaming blocks with a carry must agree with one full-walk cumsum:
+    the carried running sum IS the sliceable cumsum, block by block (same
+    exact-arithmetic construction as the bitwise test, so equality is
+    bit-for-bit, not tolerance)."""
+    from distributed_learning_simulator_tpu.ops.aggregate import (
+        block_prefix_cumsum,
+    )
+
+    rng = np.random.default_rng(5)
+    n, b = 10, 4
+    perm = rng.permutation(n)
+    weights = np.zeros((n,), np.float32)
+    weights[perm[0]] = 1.0
+    for k in range(1, n):
+        weights[perm[k]] = 2.0 ** (k - 1)
+    tree = {"w": jnp.asarray(
+        rng.integers(-8, 9, size=(n, 6)).astype(np.float32)
+    )}
+    cs_full, tot_full = block_prefix_cumsum(tree, weights, perm[None, :])
+    carry, carry_t = None, None
+    for j0 in range(0, n, b):
+        j1 = min(j0 + b, n)
+        block = np.zeros((1, b), np.int64)
+        block[0, : j1 - j0] = perm[j0:j1]  # short final block pads client 0
+        cs, tot = block_prefix_cumsum(tree, weights, block, carry, carry_t)
+        np.testing.assert_array_equal(
+            np.asarray(cs["w"][0, : j1 - j0]),
+            np.asarray(cs_full["w"][0, j0:j1]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tot[0, : j1 - j0]), np.asarray(tot_full[0, j0:j1])
+        )
+        carry = {"w": cs["w"][:, -1]}
+        carry_t = tot[:, -1]
+
+
+def test_block_prefix_cumsum_close_on_float_data(rng):
+    """General float weights/params: cumsum prefix aggregates track the
+    masked oracle to f32 rounding (the two paths associate differently,
+    so exact equality is only owed on exact-arithmetic inputs)."""
+    from distributed_learning_simulator_tpu.ops.aggregate import (
+        block_prefix_cumsum,
+        prefix_means_from_cumsum,
+    )
+
+    n = 16
+    perm = rng.permutation(n)
+    weights = rng.uniform(0.5, 3.0, size=n).astype(np.float32)
+    tree = _stacked_tree(rng, n_clients=n)
+    fallback = {k: jnp.zeros_like(v[0]) for k, v in tree.items()}
+    # Batch of 2 permutations exercises the [G, B] path.
+    perms = np.stack([perm, np.roll(perm, 3)])
+    cs, totals = block_prefix_cumsum(tree, weights, perms)
+    means = prefix_means_from_cumsum(cs, totals, fallback)
+    for g in range(2):
+        for j in range(n):
+            oracle = subset_weighted_mean(
+                tree, weights, _prefix_mask(n, perms[g], j), fallback
+            )
+            for k in tree:
+                np.testing.assert_allclose(
+                    np.asarray(means[k][g, j]), np.asarray(oracle[k]),
+                    rtol=2e-6, atol=2e-6,
+                )
+
+
+def test_prefix_means_zero_weight_falls_back(rng):
+    """A zero-total prefix (all-zero client weights) returns the fallback
+    model — the same empty-subset semantics as subset_weighted_mean."""
+    from distributed_learning_simulator_tpu.ops.aggregate import (
+        block_prefix_cumsum,
+        prefix_means_from_cumsum,
+    )
+
+    n = 4
+    tree = _stacked_tree(rng, n_clients=n)
+    fallback = {"w": jnp.full((3, 2), 7.0), "b": jnp.full((5,), -1.0)}
+    weights = np.array([0.0, 0.0, 1.0, 1.0], np.float32)
+    perm = np.array([[0, 1, 2, 3]])
+    cs, totals = block_prefix_cumsum(tree, weights, perm)
+    means = prefix_means_from_cumsum(cs, totals, fallback)
+    for k in tree:
+        # positions 0 and 1 carry zero cumulative weight -> fallback
+        np.testing.assert_allclose(np.asarray(means[k][0, 0]),
+                                   np.asarray(fallback[k]))
+        np.testing.assert_allclose(np.asarray(means[k][0, 1]),
+                                   np.asarray(fallback[k]))
+    # position 2 is the weight-1 client 2 alone
+    np.testing.assert_allclose(np.asarray(means["w"][0, 2]),
+                               np.asarray(tree["w"][2]), rtol=1e-6)

@@ -363,3 +363,179 @@ def test_subset_evaluator_oom_hint_minimal_chunk():
                np.ones((1, 4)))
     with pytest.raises(RuntimeError, match="already minimal"):
         ev(None, None, masks, None, batches)
+
+
+def _run_gtg(cfg, **overrides):
+    import dataclasses
+
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = dataclasses.replace(
+        cfg, distributed_algorithm="GTG_shapley_value", **overrides
+    )
+    return run_simulation(cfg, setup_logging=False)["history"]
+
+
+def _sv_vec(h):
+    sv = h["shapley_values"]
+    return np.array([sv[i] for i in sorted(sv)])
+
+
+def test_gtg_prefix_mode_equivalence(tiny_config):
+    """gtg_prefix_mode is pure implementation: cumsum (one streamed
+    weighted cumulative sum per permutation walk) and masked (per-prefix
+    mask-weighted reductions, the oracle) draw identical permutations from
+    the fixed seed and must produce IDENTICAL Shapley values, permutation
+    counts, convergence flags and subset-eval counts on the f32
+    exact-parity path — both aggregations compute the same real value to
+    f32 rounding, and the utilities feed an argmax accuracy that absorbs
+    last-ulp differences."""
+    out = {
+        mode: _run_gtg(
+            tiny_config, round=2, round_trunc_threshold=0.0,
+            shapley_eval_dtype="float32", gtg_prefix_mode=mode,
+        )
+        for mode in ("cumsum", "masked")
+    }
+    assert len(out["cumsum"]) == 2
+    for h_c, h_m in zip(out["cumsum"], out["masked"]):
+        np.testing.assert_array_equal(_sv_vec(h_c), _sv_vec(h_m))
+        assert h_c["gtg_permutations"] == h_m["gtg_permutations"]
+        assert h_c["gtg_subset_evals"] == h_m["gtg_subset_evals"]
+        assert h_c["gtg_converged"] == h_m["gtg_converged"]
+
+
+def test_gtg_truncated_walk_cumsum_matches_oracle(tiny_config):
+    """Eps-truncation under cumsum mode: a truncated walk stops streaming
+    its cumulative sum mid-permutation (later blocks are never computed,
+    nothing is recomputed) and must still reproduce the masked oracle's
+    values exactly. N=20 forces multi-block walks (block 16 + short final
+    block 4), so the carried running sums, the group padding of a wave's
+    last group, AND the mid-walk truncation slicing are all on the path."""
+    import dataclasses
+
+    base = dataclasses.replace(tiny_config, worker_number=20)
+    runs = {
+        mode: _run_gtg(
+            base, round=1, round_trunc_threshold=0.0,
+            shapley_eval_dtype="float32", gtg_eps=0.02, gtg_prefix_mode=mode,
+        )
+        for mode in ("cumsum", "masked")
+    }
+    h_c, h_m = runs["cumsum"][0], runs["masked"][0]
+    np.testing.assert_array_equal(_sv_vec(h_c), _sv_vec(h_m))
+    assert h_c["gtg_permutations"] == h_m["gtg_permutations"]
+    assert h_c["gtg_subset_evals"] == h_m["gtg_subset_evals"]
+    # Truncation must actually have engaged, or this test proves nothing:
+    # gtg_eps=0 disables it (|ref - v| < 0 never holds), so the truncated
+    # run must evaluate strictly fewer subsets.
+    full = _run_gtg(
+        base, round=1, round_trunc_threshold=0.0,
+        shapley_eval_dtype="float32", gtg_eps=0.0,
+        gtg_max_permutations=20, gtg_prefix_mode="cumsum",
+    )[0]
+    assert h_c["gtg_subset_evals"] < full["gtg_subset_evals"]
+
+
+def test_shapley_eval_dtype_auto_resolution(tiny_config):
+    """shapley_eval_dtype='auto' (the default) resolves per algorithm
+    (ADVICE r5): f32 for exact multi-round Shapley — its documented
+    exact-parity path has no Monte-Carlo noise to hide bf16 rounding in —
+    bf16 for GTG, where the halved stack read is measured fidelity-free.
+    An explicit value wins for both."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        GTGShapley,
+        MultiRoundShapley,
+    )
+
+    assert tiny_config.shapley_eval_dtype == "auto"
+    eval_fn = lambda *a: {"accuracy": 0.0}  # noqa: E731
+    exact = MultiRoundShapley(tiny_config)
+    exact.prepare(None, eval_fn)
+    assert exact._evaluator.eval_dtype == jnp.float32
+    gtg = GTGShapley(tiny_config)
+    gtg.prepare(None, eval_fn)
+    assert gtg._evaluator.eval_dtype == jnp.bfloat16
+    forced = dataclasses.replace(tiny_config, shapley_eval_dtype="float32")
+    gtg_f32 = GTGShapley(forced)
+    gtg_f32.prepare(None, eval_fn)
+    assert gtg_f32._evaluator.eval_dtype == jnp.float32
+
+
+def test_gtg_trunc_ref_same_estimator_for_bf16(tiny_config, tmp_path):
+    """With a non-f32 evaluator the eps-truncation reference must come
+    from the SAME estimator's grand-coalition utility, not the f32 round
+    metric (ADVICE r5) — bf16 rounding is ~eps-sized, so comparing across
+    estimators would bias truncation. Observable: with gtg_eps huge every
+    walk truncates at step 0, so the metric pickle holds exactly the
+    subsets evaluated up front — {empty, grand} when the branch takes the
+    evaluator's grand utility, {empty} when it (wrongly) reuses the round
+    metric."""
+    import dataclasses
+    import glob
+    import pickle
+
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    n = tiny_config.worker_number
+    results = {}
+    for dtype in ("bfloat16", "float32"):
+        cfg = dataclasses.replace(
+            tiny_config, distributed_algorithm="GTG_shapley_value", round=1,
+            gtg_eps=10.0, shapley_eval_dtype=dtype,
+            log_root=str(tmp_path / dtype),
+        )
+        run_simulation(cfg, setup_logging=True)
+        (path,) = glob.glob(
+            str(tmp_path / dtype / "**" / "metric_0.pkl"), recursive=True
+        )
+        with open(path, "rb") as f:
+            results[dtype] = set(pickle.load(f))
+    assert tuple(range(n)) in results["bfloat16"]
+    # f32 with no eval-sample cap keeps the reference's round-metric
+    # comparison — no extra grand-coalition evaluation happens.
+    assert tuple(range(n)) not in results["float32"]
+    assert () in results["float32"]
+
+
+def test_gtg_prefix_mode_validation(tiny_config):
+    import dataclasses
+
+    with pytest.raises(ValueError, match="gtg_prefix_mode"):
+        dataclasses.replace(tiny_config, gtg_prefix_mode="bogus").validate()
+
+
+def test_prefix_wave_oom_hint_respects_block_floor():
+    """The cumsum path's minimum call width is one prefix block (16
+    models), so at the default chunk=16 an OOM must NOT suggest a smaller
+    chunk — following that hint would dispatch the identical 16-model
+    call and crash again. The hint points at the eval-sample cap instead."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.algorithms.shapley import (
+        _CumsumPrefixWalker,
+        _SubsetEvaluator,
+    )
+
+    ev = _SubsetEvaluator(lambda *a: {"accuracy": 0.0}, chunk=16)
+
+    def boom(*a, **k):
+        raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    ev._prefix_wave = boom
+    n = 20
+    stack = {"w": jnp.zeros((n, 3), jnp.float32)}
+    batches = (jnp.zeros((2, 4, 2)), jnp.zeros((2, 4), jnp.int32),
+               jnp.ones((2, 4)))
+    walker = _CumsumPrefixWalker(
+        ev, stack, jnp.ones((n,)), {"w": jnp.zeros((3,))}, batches, n,
+    )
+    walker.reset()
+    perms = [list(range(n))] * n
+    with pytest.raises(RuntimeError, match="already minimal"):
+        walker.eval_block(perms, list(range(n)), 0, 16, {})

@@ -213,3 +213,18 @@ def test_profile_from_round_defers_trace(tmp_path, tiny_config):
     res2 = run_simulation(cfg2, setup_logging=False)
     assert len(res2["history"]) == 2
     assert not os.path.isdir(never)  # trace never started
+
+
+def test_profile_from_round_rejects_negative(tiny_config):
+    """profile_from_round < 0 is a config error (caught in validate()
+    alongside the other Shapley/profiling knob checks), not a silent
+    never-starts-tracing run."""
+    import dataclasses
+
+    import pytest
+
+    cfg = dataclasses.replace(tiny_config, profile_from_round=-1)
+    with pytest.raises(ValueError, match="profile_from_round"):
+        cfg.validate()
+    # 0 (trace from the first round) stays valid.
+    dataclasses.replace(tiny_config, profile_from_round=0).validate()
