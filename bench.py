@@ -20,7 +20,10 @@ are reported alongside for auditability.
 
 Prints ONE JSON line. Env overrides: BENCH_CLIENTS, BENCH_ROUNDS,
 BENCH_MODEL, BENCH_BATCH, BENCH_CHUNK (client_chunk_size), BENCH_DTYPE
-(local_compute_dtype). The flagship large-model configuration
+(local_compute_dtype). BENCH_FAILURE_MODE/BENCH_FAILURE_PROB/
+BENCH_MIN_SURVIVORS activate a failure model on the headline leg and add
+a ``robustness`` sub-object (rounds_rejected, mean_survivor_count) so
+perf rounds can't silently trade robustness for speed (docs/ROBUSTNESS.md). The flagship large-model configuration
 (resnet18 + chunk 40 + bf16-SR local state, docs/PERFORMANCE.md) is
 measured automatically into the ``flagship`` sub-object on default runs;
 BENCH_FLAGSHIP=0 skips it, BENCH_FLAGSHIP_ROUNDS sets its length. The
@@ -114,6 +117,20 @@ def main():
     # Per-client local-state dtype (see config.local_compute_dtype): bf16
     # halves the dominant HBM traffic at ResNet scale; f32 default.
     dtype = os.environ.get("BENCH_DTYPE", "float32")
+    # Opt-in failure model on the HEADLINE leg (docs/ROBUSTNESS.md): when
+    # active, rounds_rejected and the mean survivor count land in the
+    # bench JSON so future perf rounds can't silently trade robustness for
+    # speed. The flagship/gtg/proxy legs stay failure-free — their numbers
+    # track the unperturbed programs.
+    fail_mode = os.environ.get("BENCH_FAILURE_MODE", "none")
+    fail_prob = float(os.environ.get("BENCH_FAILURE_PROB", "0.1"))
+    min_survivors = int(os.environ.get("BENCH_MIN_SURVIVORS", "1"))
+    failure_knobs = {}
+    if fail_mode != "none":
+        failure_knobs = dict(
+            failure_mode=fail_mode, failure_prob=fail_prob,
+            min_survivors=min_survivors,
+        )
 
     common = dict(
         dataset_name="cifar10",
@@ -140,6 +157,7 @@ def main():
         round=n_rounds + 1,  # round 0 carries the XLA compile; dropped below
         client_chunk_size=chunk,
         local_compute_dtype=dtype,
+        **failure_knobs,
         **common,
     )
     from distributed_learning_simulator_tpu.data.registry import get_dataset
@@ -194,6 +212,12 @@ def main():
         ),
         "final_accuracy": result["final_accuracy"],
     }
+    if failure_knobs:
+        record["robustness"] = {
+            **failure_knobs,
+            "rounds_rejected": result["rounds_rejected"],
+            "mean_survivor_count": result["mean_survivor_count"],
+        }
 
     # Flagship: the large-model config that holds the pod-rate on one chip.
     # Driver-captured here (VERDICT r2 weak #3) — cheap because the steady

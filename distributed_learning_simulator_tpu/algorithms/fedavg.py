@@ -28,6 +28,10 @@ from distributed_learning_simulator_tpu.parallel.engine import (
     chunked_accumulate,
     make_local_train_fn,
 )
+from distributed_learning_simulator_tpu.robustness.faults import (
+    FailureModel,
+    all_finite,
+)
 
 
 class FedAvg(Algorithm):
@@ -184,6 +188,14 @@ class FedAvg(Algorithm):
         chunk = cfg.client_chunk_size
         frac = cfg.participation_fraction
         n_participants = cfg.cohort_size(n_clients)
+        # Failure model + quorum policy (robustness/faults.py): every
+        # fm-gated branch below is a TRACE-TIME conditional, so failure-free
+        # runs compile the exact pre-feature program (same RNG stream, same
+        # HLO). min_survivors without a failure model still activates the
+        # quorum guard (survivors are then just the sampled cohort).
+        fm = FailureModel.from_config(cfg)
+        min_survivors = getattr(cfg, "min_survivors", 0)
+        quorum = fm is not None or min_survivors > 0
 
         # --- size-aware work scheduling (config.bucket_client_work) --------
         # The packed-shard discipline makes every client scan
@@ -264,12 +276,24 @@ class FedAvg(Algorithm):
 
         def make_compute(global_params, lr_scale):
             """Per-chunk train+reduce body shared by the plain and bucketed
-            fused paths (chunked_accumulate's compute contract)."""
+            fused paths (chunked_accumulate's compute contract). With a
+            failure model the chunk trees carry a per-client failed flag:
+            corrupt modes damage the RAW upload before the payload
+            transform (the same point the materializing path corrupts at),
+            dropout freezes the chunk's persistent state."""
 
             def compute(chunk_trees, pk):
-                state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
+                if fm is None:
+                    state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
+                    f_c = None
+                else:
+                    state_c, x_c, y_c, m_c, keys_c, w_c, f_c = chunk_trees
                 cp, ns, tm = vtrain(global_params, state_c, x_c, y_c, m_c,
                                     keys_c, lr_scale)
+                if f_c is not None and fm.corrupts_upload:
+                    cp = fm.corrupt_stack(cp, f_c)
+                if f_c is not None and fm.freezes_client_state:
+                    ns = fm.freeze_failed_state(f_c, state_c, ns)
                 return reduce_chunk(cp, w_c, pk), (ns, tm)
 
             return compute
@@ -290,18 +314,23 @@ class FedAvg(Algorithm):
             )
 
         def train_and_reduce(global_params, state, x, y, m, keys, norm_w,
-                             payload_key, lr_scale):
+                             failed, payload_key, lr_scale):
             """Fused path: per-chunk weighted partial sums accumulate into
             the aggregate directly, so the full [n_clients, n_params] stack
             never materializes — at 1000 clients x ResNet-18 that stack
-            would be ~44 GB, far beyond HBM. Returns (aggregate, new_state,
-            train_metrics)."""
+            would be ~44 GB, far beyond HBM. ``failed`` is the failure
+            model's per-client mask (None when inactive). Returns
+            (aggregate, new_state, train_metrics)."""
             k = keys.shape[0]
 
             if chunk is None or chunk >= k:
                 cp, ns, tm = train_clients(
                     global_params, state, x, y, m, keys, lr_scale
                 )
+                if failed is not None and fm.corrupts_upload:
+                    cp = fm.corrupt_stack(cp, failed)
+                if failed is not None and fm.freezes_client_state:
+                    ns = fm.freeze_failed_state(failed, state, ns)
                 return reduce_chunk(cp, norm_w, payload_key), ns, tm
 
             # chunked_accumulate handles the reshape/scan/remainder
@@ -310,15 +339,19 @@ class FedAvg(Algorithm):
             # the full per-client param stack) and splits payload_key into
             # per-chunk keys itself.
             acc0 = jax.tree_util.tree_map(jnp.zeros_like, global_params)
+            trees = (state, x, y, m, keys, norm_w)
+            if fm is not None:
+                trees = trees + (failed,)
             agg, (ns, tm) = chunked_accumulate(
-                (state, x, y, m, keys, norm_w), chunk,
+                trees, chunk,
                 make_compute(global_params, lr_scale), acc0,
                 per_chunk=payload_key,
             )
             return agg, ns, tm
 
         def train_and_reduce_bucketed(plan, global_params, state, x, y, m,
-                                      keys, norm_w, payload_key, lr_scale):
+                                      keys, norm_w, failed, payload_key,
+                                      lr_scale):
             """Fused path with the size-aware schedule: one chunked scan per
             step-count group, each slicing the slot axis to the group's own
             length. Groups accumulate into the same f32 aggregate; per-client
@@ -353,6 +386,8 @@ class FedAvg(Algorithm):
                     keys[idx],
                     take(norm_w),
                 )
+                if fm is not None:
+                    trees_g = trees_g + (take(failed),)
                 if idx_np.size <= chunk:
                     partial, (ns_g, tm_g) = compute(trees_g, gk)
                 else:
@@ -372,7 +407,19 @@ class FedAvg(Algorithm):
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
                      lr_scale=1.0):
-            part_key, train_key, payload_key, agg_key = jax.random.split(key, 4)
+            if fm is not None:
+                # The extra split is gated so failure-free runs keep the
+                # exact pre-feature RNG streams (bit-compatible histories).
+                part_key, train_key, payload_key, agg_key, fault_key = (
+                    jax.random.split(key, 5)
+                )
+                failed = fm.draw_failed(fault_key, n_participants)
+                survival = ~failed
+            else:
+                part_key, train_key, payload_key, agg_key = (
+                    jax.random.split(key, 4)
+                )
+                failed = None
             client_keys = jax.random.split(train_key, n_participants)
             idx = None
             if n_participants == n_clients:
@@ -389,6 +436,13 @@ class FedAvg(Algorithm):
                 state_k = jax.tree_util.tree_map(take, client_state)
                 x_k, y_k, m_k = take(cx), take(cy), take(cmask)
                 part_sizes = jnp.take(sizes, idx, axis=0)
+            if failed is not None and fm.excludes_update:
+                # Dropout/straggler: zero aggregation weight. The weighted
+                # mean renormalizes over the SURVIVING part_sizes (total
+                # below shrinks too), and the robust rules' weights>0
+                # participation mask excludes failed clients from the
+                # per-coordinate statistic.
+                part_sizes = part_sizes * survival.astype(part_sizes.dtype)
             total_size = jnp.sum(part_sizes)
             norm_w = part_sizes / jnp.maximum(total_size, 1e-12)
 
@@ -413,24 +467,31 @@ class FedAvg(Algorithm):
                     # transformed upload. The eval program itself applies
                     # client_param_transform (post_round), matching the
                     # reference's QAT-instrumented eval forward exactly.
-                    # For plain fed both are identities.
+                    # For plain fed both are identities. Stored BEFORE
+                    # upload corruption: the local model trained fine; the
+                    # fault hits what the server receives.
                     aux["client_params_raw"] = client_params
+                if failed is not None and fm.corrupts_upload:
+                    client_params = fm.corrupt_stack(client_params, failed)
+                if failed is not None and fm.freezes_client_state:
+                    new_state_k = fm.freeze_failed_state(
+                        failed, state_k, new_state_k
+                    )
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )
                 new_global = aggregate(
                     client_params, part_sizes, aggregation, cfg.trim_ratio
                 )
-                if aggregation != "mean":
+                if aggregation != "mean" and not quorum:
                     # Robust rules promise a usable model even under
                     # poisoning; if EVERY client diverged in the same round
                     # (all candidates masked), keep the previous global
                     # instead of a NaN aggregate. The plain mean keeps
-                    # propagate-NaN semantics (reference parity).
-                    finite = jnp.all(jnp.stack([
-                        jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
-                        for leaf in jax.tree_util.tree_leaves(new_global)
-                    ]))
+                    # propagate-NaN semantics (reference parity). With the
+                    # quorum guard active this fallback is subsumed by the
+                    # rejection logic below — which also RECORDS the event.
+                    finite = all_finite(new_global)
                     new_global = jax.tree_util.tree_map(
                         lambda agg, prev: jnp.where(
                             finite, agg, prev.astype(agg.dtype)
@@ -443,8 +504,6 @@ class FedAvg(Algorithm):
                     # resident stack, matching what
                     # _assert_client_stack_feasible budgets for.
                     aux["client_params"] = client_params
-                    if idx is not None:
-                        aux["participants"] = idx
             else:
                 plan = None
                 if bucket_sizes is not None:
@@ -457,26 +516,63 @@ class FedAvg(Algorithm):
                     new_global, new_state_k, train_metrics = (
                         train_and_reduce_bucketed(
                             plan, global_params, state_k, x_k, y_k, m_k,
-                            client_keys, norm_w, payload_key, lr_scale,
+                            client_keys, norm_w, failed, payload_key,
+                            lr_scale,
                         )
                     )
                 else:
                     new_global, new_state_k, train_metrics = train_and_reduce(
                         global_params, state_k, x_k, y_k, m_k, client_keys,
-                        norm_w, payload_key, lr_scale,
+                        norm_w, failed, payload_key, lr_scale,
                     )
                 payload_aux = {}
             # Empty effective cohort (all sampled clients have zero samples,
-            # possible under extreme Dirichlet skew): keep the previous
-            # global model, parity with fed_server.py:45-47.
+            # possible under extreme Dirichlet skew — or the whole cohort
+            # dropped out): keep the previous global model, parity with
+            # fed_server.py:45-47.
             new_global = jax.tree_util.tree_map(
                 lambda agg, prev: jnp.where(
                     total_size > 0, agg, prev.astype(agg.dtype)
                 ),
                 new_global, global_params,
             )
+            if quorum:
+                # Quorum policy: a round is REJECTED — previous global
+                # retained, the event recorded — when honest survivors fall
+                # below min_survivors OR the aggregate is non-finite (the
+                # plain mean otherwise NaN-propagates a corrupt upload into
+                # the global model forever). Checked after the empty-cohort
+                # fallback (an empty round is a survivor-floor event, not a
+                # NaN event) and INSTEAD of the robust-rule finite guard,
+                # which it subsumes; in-program jnp.where keeps the whole
+                # round one XLA program (no host sync to decide).
+                survivor_count = (
+                    jnp.sum(survival.astype(jnp.int32))
+                    if failed is not None
+                    else jnp.asarray(n_participants, jnp.int32)
+                )
+                finite = all_finite(new_global)
+                rejected = (~finite) | (survivor_count < min_survivors)
+                aux["survivor_count"] = survivor_count
+                aux["round_rejected"] = rejected
             new_global, agg_aux = self.process_aggregated(new_global, agg_key)
+            if quorum:
+                # The rejection select runs AFTER process_aggregated so a
+                # rejected round retains the previous global EXACTLY: the
+                # round's input params already went through the downlink
+                # transform last round (fed_quant re-quantizing the
+                # "retained" model with fresh noise would move it).
+                new_global = jax.tree_util.tree_map(
+                    lambda agg, prev: jnp.where(
+                        rejected, prev.astype(agg.dtype), agg
+                    ),
+                    new_global, global_params,
+                )
             if idx is not None:
+                # Sampled cohort indices: third-party post_round attribution
+                # and the host loop's cohort_hash resume-determinism
+                # telemetry.
+                aux["participants"] = idx
                 new_state = jax.tree_util.tree_map(
                     lambda s, ns: s.at[idx].set(ns), client_state, new_state_k
                 )
@@ -524,11 +620,29 @@ class FedAvg(Algorithm):
                 f"unknown server optimizer {name!r}; known: none, sgd, adam"
             )
 
-        def update(prev_global, aggregate, opt_state):
+        def update(prev_global, aggregate, opt_state, rejected=None):
             pseudo_grad = jax.tree_util.tree_map(
                 lambda p, a: (p - a.astype(p.dtype)), prev_global, aggregate
             )
-            updates, opt_state = tx.update(pseudo_grad, opt_state, prev_global)
-            return optax.apply_updates(prev_global, updates), opt_state
+            updates, new_opt_state = tx.update(
+                pseudo_grad, opt_state, prev_global
+            )
+            stepped = optax.apply_updates(prev_global, updates)
+            if rejected is None:
+                return stepped, new_opt_state
+            # Quorum rejection (the simulator passes the round's rejected
+            # flag whenever the round program produced one): a rejected
+            # round's pseudo-gradient is 0, but a momentum trace / Adam
+            # moments from PRIOR rounds would still move the params and
+            # advance the optimizer state — "previous global retained"
+            # must mean exactly that, so both are frozen.
+            params = jax.tree_util.tree_map(
+                lambda s, p: jnp.where(rejected, p, s), stepped, prev_global
+            )
+            frozen_opt = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(rejected, o, n),
+                new_opt_state, opt_state,
+            )
+            return params, frozen_opt
 
         return tx.init, update

@@ -428,6 +428,20 @@ def _check_shapley_config(config) -> None:
             "Shapley scoring assumes the weighted-mean aggregator (subset "
             "utilities are weighted means); set aggregation='mean'"
         )
+    from distributed_learning_simulator_tpu.robustness.faults import (
+        FailureModel,
+    )
+
+    if FailureModel.from_config(config) is not None:
+        # The subset-utility memo keys subsets of a FIXED cohort whose
+        # every update is honest; a client that drops out or uploads
+        # garbage silently invalidates every memoized utility that
+        # includes it — refuse rather than score garbage.
+        raise ValueError(
+            "Shapley scoring refuses failure injection: the subset-utility "
+            "memo assumes a fixed cohort of honest updates; set "
+            "failure_mode='none'"
+        )
 
 
 class MultiRoundShapley(FedAvg):

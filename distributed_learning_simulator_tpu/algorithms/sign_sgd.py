@@ -38,6 +38,10 @@ from distributed_learning_simulator_tpu.parallel.engine import (
     chunked_accumulate,
     make_loss_fn,
 )
+from distributed_learning_simulator_tpu.robustness.faults import (
+    FailureModel,
+    all_finite,
+)
 
 
 class SignSGD(Algorithm):
@@ -79,6 +83,19 @@ class SignSGD(Algorithm):
                 "sign_SGD votes over every client each step; "
                 "participation_fraction < 1 is not supported"
             )
+        if FailureModel.from_config(config) is not None and getattr(
+            config, "failure_mode", "none"
+        ) in ("corrupt_nan", "corrupt_scale"):
+            # The uplink here is a 1-bit sign vote — there is no
+            # parameter-space payload to corrupt (sign(NaN) would poison
+            # the vote sum itself, which models a broken SERVER, not a
+            # faulty client). Dropout/straggler apply: a failed client's
+            # votes are excluded and the threshold counts survivors only.
+            raise ValueError(
+                "sign_SGD supports failure_mode dropout/straggler only "
+                "(its 1-bit vote has no parameter payload to corrupt); "
+                f"got {config.failure_mode!r}"
+            )
 
     def init_client_state(self, optimizer, global_params, n_clients):
         """Per-client momentum buffers + step counters (reference replicates
@@ -114,6 +131,16 @@ class SignSGD(Algorithm):
 
         chunk = cfg.client_chunk_size
         has_momentum = mu != 0.0
+        # Failure model (robustness/faults.py): dropout/straggler only (the
+        # constructor rejects corrupt modes). Drawn ONCE per round from the
+        # round key — a failed client misses the whole round's lockstep:
+        # its per-step votes are excluded (the majority threshold counts
+        # surviving voters only) and its momentum/step state freezes.
+        # Every fm-gated branch is trace-time, so failure-free runs compile
+        # the exact pre-feature program.
+        fm = FailureModel.from_config(cfg)
+        min_survivors = getattr(cfg, "min_survivors", 0)
+        quorum = fm is not None or min_survivors > 0
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
                      lr_scale=1.0):
@@ -124,10 +151,22 @@ class SignSGD(Algorithm):
             del sizes  # vote is unweighted, parity with sign_sgd_server.py:16-18
             shard_size = cx.shape[1]
             steps_per_epoch = shard_size // batch_size
+            if fm is not None:
+                key, fault_key = jax.random.split(key)
+                failed = fm.draw_failed(fault_key, n_clients)
+                surv_f = (~failed).astype(jnp.float32)  # [C]
+                n_surv = jnp.sum(surv_f).astype(jnp.int32)
+                any_surv = n_surv > 0
+            else:
+                surv_f = None
 
-            def chunk_compute(params, momenta_c, is_first_c, bx, by, bm):
+            def chunk_compute(params, momenta_c, is_first_c, bx, by, bm,
+                              surv_c=None):
                 """Per-chunk: grads at the shared params -> torch-SGD
                 direction -> partial sign-sum over the chunk's clients.
+                ``surv_c`` (f32 0/1 per client; None when no failure model)
+                zeroes excluded voters' signs, freezes their momenta, and
+                drops them from the loss sum.
                 Returns (vote partial sums, new momenta, summed loss)."""
                 if preprocess is not None:
                     bx = jax.vmap(preprocess)(bx)
@@ -155,10 +194,32 @@ class SignSGD(Algorithm):
                     # reduces to it too).
                     momenta_new = momenta_c
                     direction = grads
-                partial = jax.tree_util.tree_map(
-                    lambda d: jnp.sum(jnp.sign(d), axis=0), direction
-                )
-                return partial, momenta_new, jnp.sum(losses)
+                if surv_c is None:
+                    partial = jax.tree_util.tree_map(
+                        lambda d: jnp.sum(jnp.sign(d), axis=0), direction
+                    )
+                    loss_sum = jnp.sum(losses)
+                else:
+                    partial = jax.tree_util.tree_map(
+                        lambda d: jnp.sum(
+                            jnp.sign(d)
+                            * surv_c.reshape((-1,) + (1,) * (d.ndim - 1)),
+                            axis=0,
+                        ),
+                        direction,
+                    )
+                    loss_sum = jnp.sum(losses * surv_c)
+                    if has_momentum:
+                        momenta_new = jax.tree_util.tree_map(
+                            lambda old, new: jnp.where(
+                                surv_c.reshape(
+                                    (-1,) + (1,) * (new.ndim - 1)
+                                ) > 0,
+                                new, old,
+                            ),
+                            momenta_c, momenta_new,
+                        )
+                return partial, momenta_new, loss_sum
 
             def epoch_body(carry, epoch_key):
                 params, momenta, step_counts = carry
@@ -179,7 +240,7 @@ class SignSGD(Algorithm):
 
                     if chunk is None or chunk >= n_clients:
                         vote_sum, momenta_new, loss_sum = chunk_compute(
-                            params, momenta, is_first, bx, by, bm
+                            params, momenta, is_first, bx, by, bm, surv_f
                         )
                     else:
                         # Chunked vote: per-client gradients exist only
@@ -190,9 +251,13 @@ class SignSGD(Algorithm):
                         # (parallel/engine.py) holds the reshape/scan/
                         # remainder discipline — any chunk size works.
                         def compute(chunk_trees, _pc):
-                            m_c, f_c, bx_c, by_c, bm_c = chunk_trees
+                            if surv_f is None:
+                                m_c, f_c, bx_c, by_c, bm_c = chunk_trees
+                                s_c = None
+                            else:
+                                m_c, f_c, bx_c, by_c, bm_c, s_c = chunk_trees
                             partial, m_new, l_sum = chunk_compute(
-                                params, m_c, f_c, bx_c, by_c, bm_c
+                                params, m_c, f_c, bx_c, by_c, bm_c, s_c
                             )
                             return (partial, l_sum), m_new
 
@@ -203,21 +268,39 @@ class SignSGD(Algorithm):
                             ),
                             jnp.float32(0.0),
                         )
+                        trees = (momenta, is_first, bx, by, bm)
+                        if surv_f is not None:
+                            trees = trees + (surv_f,)
                         (vote_sum, loss_sum), momenta_new = (
                             chunked_accumulate(
-                                (momenta, is_first, bx, by, bm), chunk,
+                                trees, chunk,
                                 compute, acc0,
                             )
                         )
                     # sign of the summed signs: the majority vote
-                    # (sign_sgd_server.py:16-18).
+                    # (sign_sgd_server.py:16-18) — over surviving voters
+                    # only when a failure model is active (excluded signs
+                    # contribute 0 to the sum).
                     voted = jax.tree_util.tree_map(jnp.sign, vote_sum)
-                    params = jax.tree_util.tree_map(
+                    new_params = jax.tree_util.tree_map(
                         lambda p, v: vote_apply_leaf(p, v, lr, wd),
                         params, voted,
                     )
-                    return (params, momenta_new, step_counts + 1), (
-                        loss_sum / n_clients
+                    if surv_f is not None:
+                        # A zero-survivor round must not silently apply
+                        # weight decay (no client stepped at all); steps
+                        # advance only for clients that participated.
+                        new_params = jax.tree_util.tree_map(
+                            lambda nw, od: jnp.where(any_surv, nw, od),
+                            new_params, params,
+                        )
+                        step_inc = surv_f.astype(jnp.int32)
+                        denom = jnp.maximum(n_surv, 1).astype(jnp.float32)
+                    else:
+                        step_inc = 1
+                        denom = n_clients
+                    return (new_params, momenta_new, step_counts + step_inc), (
+                        loss_sum / denom
                     )
 
                 (params, momenta, step_counts), step_losses = jax.lax.scan(
@@ -241,6 +324,25 @@ class SignSGD(Algorithm):
                 "mean_client_loss": epoch_losses[-1],
                 "sync_steps": jnp.asarray(epochs * steps_per_epoch),
             }
+            if quorum:
+                # Quorum policy (mirrors fedavg.round_fn): reject the round
+                # — revert to the round-start params — when survivors fall
+                # below min_survivors or the voted params went non-finite.
+                # Momentum/step state keeps its per-client masking (failed
+                # clients froze themselves above); rejection only refuses
+                # the SHARED model the round produced.
+                survivor_count = (
+                    n_surv if fm is not None
+                    else jnp.asarray(n_clients, jnp.int32)
+                )
+                finite = all_finite(params)
+                rejected = (~finite) | (survivor_count < min_survivors)
+                params = jax.tree_util.tree_map(
+                    lambda nw, od: jnp.where(rejected, od.astype(nw.dtype), nw),
+                    params, global_params,
+                )
+                aux["survivor_count"] = survivor_count
+                aux["round_rejected"] = rejected
             new_state = (
                 {"momenta": momenta, "steps": step_counts}
                 if has_momentum else None

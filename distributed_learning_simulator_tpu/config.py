@@ -71,6 +71,34 @@ class ExperimentConfig:
     # the feasible client count.
     aggregation: str = "mean"
     trim_ratio: float = 0.1
+    # --- failure model (robustness/faults.py; docs/ROBUSTNESS.md) ----------
+    # Per-round client fault injection drawn inside the jitted round from
+    # the round key: "none" | "dropout" (never trains; excluded + state
+    # frozen) | "straggler" (trains but upload arrives late; excluded) |
+    # "corrupt_nan" (uploads all-NaN params at full weight) |
+    # "corrupt_scale" (uploads its update scaled 100x — finite Byzantine
+    # garbage). FedAvg-family and sign_SGD (dropout/straggler only; a 1-bit
+    # vote has no parameter-space garbage to inject); the Shapley
+    # algorithms refuse any failure model (their utility memo assumes a
+    # fixed cohort). Composes with participation_fraction: a
+    # sampled-but-failed client contributes nothing.
+    failure_mode: str = "none"
+    failure_prob: float = 0.0
+    # Round-correlated outages: with probability `failure_correlation` a
+    # client's failure draw is replaced by one draw SHARED across the
+    # round's cohort — marginal rate stays failure_prob, failures cluster
+    # into bad rounds (1.0 = all-or-nothing rounds).
+    failure_correlation: float = 0.0
+    # Re-rolls WHICH clients fail without touching cohort sampling,
+    # training batches, or payload keys (fold_in-decoupled stream).
+    failure_seed: int = 0
+    # Quorum policy (host loop + round program): a round whose survivor
+    # count falls below min_survivors — or whose aggregate is non-finite —
+    # is REJECTED in-program: the previous global model is retained, and
+    # rounds_rejected / survivor_count land in the metrics record and
+    # result dict. 0 disables the survivor floor (the non-finite guard
+    # still engages whenever a failure model is active).
+    min_survivors: int = 0
     # --- server optimizer (FedOpt family; exceeds the reference) -----------
     # "none" = plain FedAvg (the reference's fixed behavior: the aggregate IS
     # the new global model). "sgd"/"adam" treat (prev_global - aggregate) as
@@ -251,6 +279,12 @@ class ExperimentConfig:
     log_root: str = "log"
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # rounds; 0 = disabled
+    # Retention: keep only the newest N checkpoints (GC after each
+    # successful save), so week-long chaos/preemption runs don't fill the
+    # disk. None = keep all. Keep >= 2 when integrity matters: resume
+    # falls back past a corrupt/truncated latest checkpoint to the newest
+    # VALID one (utils/checkpoint.py).
+    checkpoint_keep_last: int | None = None
     resume: bool = False
 
     def cohort_size(self, n_clients: int | None = None) -> int:
@@ -316,6 +350,48 @@ class ExperimentConfig:
                     f"assumed Byzantine f={f}); lower trim_ratio or raise "
                     "worker_number/participation_fraction"
                 )
+        from distributed_learning_simulator_tpu.robustness.faults import (
+            MODES as _FAILURE_MODES,
+        )
+
+        if self.failure_mode not in _FAILURE_MODES:
+            raise ValueError(
+                f"unknown failure_mode {self.failure_mode!r}; known: "
+                + ", ".join(_FAILURE_MODES)
+            )
+        if not 0.0 <= self.failure_prob <= 1.0:
+            raise ValueError("failure_prob must be in [0, 1]")
+        if not 0.0 <= self.failure_correlation <= 1.0:
+            raise ValueError("failure_correlation must be in [0, 1]")
+        if self.min_survivors < 0:
+            raise ValueError("min_survivors must be >= 0")
+        if self.min_survivors > self.cohort_size():
+            raise ValueError(
+                f"min_survivors={self.min_survivors} exceeds the sampled "
+                f"cohort size ({self.cohort_size()}); every round would be "
+                "rejected — lower it or raise worker_number/"
+                "participation_fraction"
+            )
+        _failure_active = (
+            self.failure_mode != "none" and self.failure_prob > 0.0
+        )
+        if _failure_active:
+            # (The Shapley algorithms refuse failure injection too, but in
+            # ONE place — their constructors via _check_shapley_config —
+            # so the refusal can't drift across an algorithm-name list
+            # kept here.)
+            if self.execution_mode.lower() == "threaded":
+                raise ValueError(
+                    "the threaded execution oracle does not model client "
+                    "failures; use execution_mode='vmap' with a failure "
+                    "model"
+                )
+        if self.checkpoint_keep_last is not None and (
+            self.checkpoint_keep_last < 1
+        ):
+            raise ValueError(
+                "checkpoint_keep_last must be >= 1 or None (= keep all)"
+            )
         if self.local_compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"unknown local_compute_dtype {self.local_compute_dtype!r}; "
@@ -454,7 +530,8 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
             )
         elif f.name in ("n_train", "n_test", "mesh_devices", "num_processes",
                         "process_id", "lr_schedule_rounds",
-                        "shapley_eval_samples", "gtg_max_permutations"):
+                        "shapley_eval_samples", "gtg_max_permutations",
+                        "checkpoint_keep_last"):
             parser.add_argument(arg, type=int, default=None)
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
                         "profile_dir", "client_chunk_size", "max_shard_size",

@@ -1,0 +1,19 @@
+"""Failure injection and recovery (docs/ROBUSTNESS.md).
+
+The reference simulator has zero fault tolerance — one worker that never
+reports back deadlocks the server's blocking barrier forever
+(fed_server.py:75-77). This package provides the *attack* side that the
+repo's existing defenses (robust aggregation rules, atomic checkpoints)
+were missing: an injectable per-round client failure model
+(:mod:`.faults`) and a deterministic crash-injection hook for the chaos
+harness (:mod:`.chaos`).
+"""
+
+from distributed_learning_simulator_tpu.robustness.chaos import (  # noqa: F401
+    InjectedCrash,
+    maybe_crash,
+)
+from distributed_learning_simulator_tpu.robustness.faults import (  # noqa: F401
+    FailureModel,
+    all_finite,
+)

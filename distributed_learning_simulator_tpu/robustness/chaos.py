@@ -1,0 +1,61 @@
+"""Deterministic crash injection for the chaos harness.
+
+``run_simulation`` calls :func:`maybe_crash` at the end of every round's
+finalize step — AFTER that round's metrics line and checkpoint (if due)
+are on disk — so the injected failure models "the process died right
+after persisting round N". Three kinds, selected by environment variables
+so the same hook drives in-process tests, subprocess SIGKILL tests, and
+the SIGTERM grace-path test without any test-only wiring in the
+simulator:
+
+  * ``DLS_CRASH_KIND=raise`` (default) — raise :class:`InjectedCrash`;
+    the exception unwinds through the host loop's crash-flush paths
+    (useful in-process: pytest catches it).
+  * ``DLS_CRASH_KIND=sigkill`` — ``SIGKILL`` to self: no cleanup, no
+    ``finally`` blocks, no atexit — the torn-state variant a real
+    preemption or OOM-kill produces.
+  * ``DLS_CRASH_KIND=sigterm`` — ``SIGTERM`` to self: exercises the
+    graceful-preemption path (finish the in-flight round, write a final
+    checkpoint, exit cleanly) deterministically instead of racing a
+    parent-process kill timer.
+
+The hook is inert unless ``DLS_CRASH_AT_ROUND`` is set, and costs one
+environment lookup per round.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+ENV_CRASH_ROUND = "DLS_CRASH_AT_ROUND"
+ENV_CRASH_KIND = "DLS_CRASH_KIND"
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by the ``raise`` crash kind; never by production code paths."""
+
+
+def maybe_crash(round_idx: int) -> None:
+    """Kill this process if ``DLS_CRASH_AT_ROUND`` names ``round_idx``."""
+    target = os.environ.get(ENV_CRASH_ROUND)
+    if target is None:
+        return
+    try:
+        target_round = int(target)
+    except ValueError as e:
+        raise ValueError(
+            f"{ENV_CRASH_ROUND}={target!r} is not an integer round index"
+        ) from e
+    if target_round != round_idx:
+        return
+    kind = os.environ.get(ENV_CRASH_KIND, "raise").lower()
+    if kind == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif kind == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+        return  # handler only sets a flag; the round loop exits gracefully
+    else:
+        raise InjectedCrash(
+            f"injected crash after round {round_idx} was persisted"
+        )

@@ -1,0 +1,156 @@
+"""Per-round client failure model, applied INSIDE the jitted round.
+
+FedJAX (arXiv:2108.02117) treats client failure simulation as a
+first-class framework primitive; the reference simulator instead deadlocks
+on the first client that never reports (fed_server.py:75-77). This module
+is the injectable attack surface for the repo's existing defenses
+(ops/aggregate.py robust rules, the host loop's quorum policy): a
+:class:`FailureModel` built from config draws a per-client failure mask
+from the ROUND key every round — no retrace across rounds, replicated
+(hence consistent) under mesh sharding, and resume-deterministic because
+the round key chain is checkpointed.
+
+Failure modes (``config.failure_mode``):
+
+  * ``dropout`` — the client never trains this round: its update is
+    excluded from aggregation (weight 0, survivors renormalized) and its
+    persistent per-client state is frozen.
+  * ``straggler`` — the client trains but its upload arrives after the
+    round closes: update excluded like dropout, but its local state
+    advances (it did the work; only the server missed it).
+  * ``corrupt_nan`` — the client reports on time but its upload is
+    garbage: every parameter is NaN. Keeps its aggregation weight (the
+    server cannot know the payload is poison before aggregating).
+  * ``corrupt_scale`` — finite Byzantine garbage: the upload is the true
+    update scaled by :data:`CORRUPT_SCALE` (a large-norm attack that NaN
+    guards cannot see but median/trimmed-mean/krum must absorb).
+
+``failure_correlation`` models round-correlated outages (a rack power
+event takes out many clients at once): each client's uniform draw is
+replaced, with probability ``correlation``, by one draw SHARED across the
+round's cohort — the marginal per-client failure rate stays exactly
+``failure_prob`` while failures cluster into bad rounds; ``1.0`` makes
+every round all-or-nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+#: Multiplier a ``corrupt_scale`` client applies to its upload. Large
+#: enough that an unweighted mean over a reference-sized cohort moves by
+#: an order of magnitude (the attack is visible), small enough to stay
+#: finite in f32 through any downstream payload transform.
+CORRUPT_SCALE = 100.0
+
+MODES = ("none", "dropout", "straggler", "corrupt_nan", "corrupt_scale")
+
+
+def all_finite(tree):
+    """Scalar bool: every leaf of ``tree`` is finite (shared by the robust
+    aggregation guard and the quorum policy in fedavg/sign_sgd)."""
+    return jnp.all(jnp.stack([
+        jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]))
+
+
+@dataclass(frozen=True)
+class FailureModel:
+    """Static (trace-time) failure configuration; per-round draws are pure
+    functions of the round key, so one compiled round program serves every
+    round."""
+
+    mode: str
+    prob: float
+    correlation: float = 0.0
+    seed: int = 0
+
+    @classmethod
+    def from_config(cls, config) -> "FailureModel | None":
+        """None when no failure model is active (``mode='none'`` or
+        ``prob<=0``) — callers gate every trace-time branch on that, so
+        failure-free runs compile the exact pre-feature program."""
+        mode = getattr(config, "failure_mode", "none") or "none"
+        prob = float(getattr(config, "failure_prob", 0.0))
+        if mode == "none" or prob <= 0.0:
+            return None
+        if mode not in MODES:
+            raise ValueError(
+                f"unknown failure_mode {mode!r}; known: {', '.join(MODES)}"
+            )
+        return cls(
+            mode=mode,
+            prob=prob,
+            correlation=float(getattr(config, "failure_correlation", 0.0)),
+            seed=int(getattr(config, "failure_seed", 0)),
+        )
+
+    # ---- mode semantics (trace-time predicates) ---------------------------
+    @property
+    def excludes_update(self) -> bool:
+        """Failed client contributes nothing to aggregation (weight 0);
+        survivors are renormalized over the remaining weight."""
+        return self.mode in ("dropout", "straggler")
+
+    @property
+    def corrupts_upload(self) -> bool:
+        """Failed client reports garbage WITH its full aggregation weight."""
+        return self.mode in ("corrupt_nan", "corrupt_scale")
+
+    @property
+    def freezes_client_state(self) -> bool:
+        """Dropout never ran locally, so persistent per-client state
+        (momentum buffers, non-reset optimizers) must not advance; a
+        straggler trained — only its upload was lost."""
+        return self.mode == "dropout"
+
+    # ---- jit-side draws ----------------------------------------------------
+    def draw_failed(self, key, n: int):
+        """Bool ``[n]`` failure mask for one round's cohort.
+
+        ``fold_in(key, seed)`` decouples the failure stream from every
+        other consumer of the round key: changing ``failure_seed`` re-rolls
+        WHICH clients fail without touching cohort sampling, training
+        batches, or payload keys (and vice versa).
+        """
+        k = jax.random.fold_in(key, self.seed)
+        k_common, k_ind, k_mix = jax.random.split(k, 3)
+        u_ind = jax.random.uniform(k_ind, (n,))
+        if self.correlation > 0.0:
+            u_common = jax.random.uniform(k_common, ())
+            use_common = jax.random.uniform(k_mix, (n,)) < self.correlation
+            u = jnp.where(use_common, u_common, u_ind)
+        else:
+            u = u_ind
+        return u < self.prob
+
+    def corrupt_stack(self, stacked_tree, failed):
+        """Apply the corrupt-mode payload damage to a client-stacked pytree
+        (leading axis = clients). Applied to the RAW upload, before any
+        payload transform (quantization happens client-side too, so a
+        faulty client quantizes its own garbage)."""
+        def _leaf(x):
+            f = failed.reshape((-1,) + (1,) * (x.ndim - 1))
+            if self.mode == "corrupt_nan":
+                bad = jnp.full_like(x, jnp.nan)
+            else:
+                bad = x * jnp.asarray(CORRUPT_SCALE, x.dtype)
+            return jnp.where(f, bad, x)
+
+        return jax.tree_util.tree_map(_leaf, stacked_tree)
+
+    def freeze_failed_state(self, failed, old_state, new_state):
+        """Per-client persistent state for failed clients reverts to its
+        round-start value (dropout semantics); no-op for stateless runs."""
+        if old_state is None or new_state is None:
+            return new_state
+
+        def _leaf(old, new):
+            f = failed.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(f, old, new)
+
+        return jax.tree_util.tree_map(_leaf, old_state, new_state)

@@ -22,7 +22,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal
+import threading
 import time
+import zlib
 from contextlib import ExitStack, contextmanager
 
 import jax
@@ -52,10 +55,11 @@ from distributed_learning_simulator_tpu.parallel.mesh import (
     replicate,
     shard_client_data,
 )
+from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
 from distributed_learning_simulator_tpu.utils.errors import is_device_oom
 from distributed_learning_simulator_tpu.utils.checkpoint import (
-    latest_checkpoint,
-    load_checkpoint,
+    gc_checkpoints,
+    load_latest_valid_checkpoint,
     save_checkpoint,
 )
 from distributed_learning_simulator_tpu.utils.logging import (
@@ -452,10 +456,12 @@ def run_simulation(
         optimizer, global_params, n_clients
     )
     if config.resume and config.checkpoint_dir:
-        ckpt_path = latest_checkpoint(config.checkpoint_dir)
+        # Integrity-verified discovery: a corrupt/truncated latest
+        # checkpoint (CRC mismatch) is skipped with a warning and resume
+        # falls back to the newest VALID one instead of crashing.
+        ckpt_path, ckpt = load_latest_valid_checkpoint(config.checkpoint_dir)
         if ckpt_path:
             resumed_basename = os.path.basename(ckpt_path)
-            ckpt = load_checkpoint(ckpt_path)
             want_gp = jax.tree_util.tree_structure(global_params)
             got_gp = jax.tree_util.tree_structure(ckpt["global_params"])
             if want_gp != got_gp:
@@ -545,8 +551,6 @@ def run_simulation(
             # mismatch (hang) or a silent split. Verify agreement before
             # any sharded dispatch; checkpoint_dir must be on storage all
             # hosts see (NFS/GCS-fuse) for multihost resume.
-            import zlib
-
             from jax.experimental import multihost_utils
 
             local = np.asarray(
@@ -626,13 +630,22 @@ def run_simulation(
     t_start = time.perf_counter()
     t_prev_done = t_start
     pending: dict | None = None
+    # Robustness telemetry (docs/ROBUSTNESS.md): per-round survivor counts
+    # and quorum rejections, accumulated for the result dict so callers
+    # (and bench.py) can't silently trade robustness for speed.
+    telemetry = {"rounds_rejected": 0, "survivor_counts": []}
 
     def finalize(p: dict) -> None:
         nonlocal prev_metrics, t_prev_done
+        tel_keys = [
+            k for k in ("survivor_count", "round_rejected", "participants")
+            if k in p["aux"]
+        ]
         with _oom_hint(config, p["new_global"], n_clients,
                        site="deferred metric fetch"):
-            fetched_metrics, fetched_loss = jax.device_get(
-                (p["metrics_dev"], p["mean_loss_dev"])
+            fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
+                (p["metrics_dev"], p["mean_loss_dev"],
+                 {k: p["aux"][k] for k in tel_keys})
             )
         metrics = {k: float(v) for k, v in fetched_metrics.items()}
         ctx = RoundContext(
@@ -665,6 +678,29 @@ def run_simulation(
         }
         if config.lr_schedule.lower() != "constant":
             record["lr_factor"] = _lr_factor(config, p["round_idx"])
+        if "survivor_count" in fetched_tel:
+            record["survivor_count"] = int(fetched_tel["survivor_count"])
+            telemetry["survivor_counts"].append(record["survivor_count"])
+        if "round_rejected" in fetched_tel:
+            record["round_rejected"] = bool(fetched_tel["round_rejected"])
+            if record["round_rejected"]:
+                telemetry["rounds_rejected"] += 1
+                logger.warning(
+                    "round %d REJECTED by quorum policy (survivors=%s, "
+                    "min_survivors=%d): previous global model retained",
+                    p["round_idx"], record.get("survivor_count"),
+                    config.min_survivors,
+                )
+        if "participants" in fetched_tel:
+            # CRC of the sampled cohort: a compact per-round fingerprint
+            # that lets the resume-determinism tests assert the cohort
+            # sampling stream survives checkpoint/resume bit-exactly
+            # without bloating metrics.jsonl with index lists.
+            record["cohort_hash"] = zlib.crc32(
+                np.ascontiguousarray(
+                    fetched_tel["participants"], dtype=np.int64
+                ).tobytes()
+            )
         t_prev_done = now
         history.append(record)
         if metrics_path:
@@ -695,8 +731,34 @@ def run_simulation(
                 p["round_idx"], p["new_global"], p["client_state"],
                 algo_state, p["key"],
             )
+            gc_checkpoints(config.checkpoint_dir, config.checkpoint_keep_last)
+        # Chaos-harness hook (robustness/chaos.py): inert unless
+        # DLS_CRASH_AT_ROUND is set. Placed after the checkpoint block so
+        # an injected crash models "the process died right after round N
+        # was persisted".
+        maybe_crash(p["round_idx"])
 
     profile_from = getattr(config, "profile_from_round", 0)
+    # SIGTERM grace hook (TPU preemption notice, docs/ROBUSTNESS.md): the
+    # handler only sets a flag; the round loop finishes the in-flight
+    # round, flushes any deferred round, writes a final checkpoint, and
+    # returns cleanly. Installed only in the main thread (signal.signal
+    # raises elsewhere — e.g. the threaded test harness), and the previous
+    # handler is restored on exit so library callers keep their own.
+    preempt = {"flag": False}
+    prev_sigterm = None
+    sigterm_installed = False
+    if threading.current_thread() is threading.main_thread():
+        def _on_sigterm(signum, frame):
+            preempt["flag"] = True
+
+        try:
+            prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+            sigterm_installed = True
+        except ValueError:
+            pass
+    completed_round = start_round - 1
+    preempted_at = None
     with ExitStack() as profile_stack:
         if config.profile_dir and profile_from <= start_round:
             profile_stack.enter_context(profile_session(config.profile_dir))
@@ -739,9 +801,15 @@ def run_simulation(
                         round_key, *lr_args,
                     )
                     if server_update_jit is not None:
-                        new_global, server_state = server_update_jit(
-                            global_params, new_global, server_state
-                        )
+                        # When the round program carries a quorum verdict,
+                        # the server optimizer must see it: a rejected
+                        # round freezes the optimizer state and leaves the
+                        # params untouched (momentum alone would otherwise
+                        # move the "retained" model).
+                        srv_args = (global_params, new_global, server_state)
+                        if "round_rejected" in aux:
+                            srv_args += (aux["round_rejected"],)
+                        new_global, server_state = server_update_jit(*srv_args)
                 with annotate("server_eval"), _oom_hint(
                     config, global_params, n_clients, site="eval"
                 ):
@@ -767,7 +835,15 @@ def run_simulation(
                         finalize(prev_pending)
                 else:
                     finalize(entry)
+                completed_round = round_idx
+                if preempt["flag"]:
+                    # Finish-in-flight semantics: this round completed (and
+                    # with pipelining its deferred finalize runs in the
+                    # crash-flush below); no new round is dispatched.
+                    break
         finally:
+            if sigterm_installed:
+                signal.signal(signal.SIGTERM, prev_sigterm)
             if pending is not None:
                 # Crash-flush of the last deferred round. Best-effort: if
                 # finalize itself is what failed in-loop (full disk, post_round
@@ -783,8 +859,49 @@ def run_simulation(
                 finally:
                     pending = None
 
+    if preempt["flag"]:
+        # Graceful preemption: the in-flight round finished and was
+        # finalized above; persist it even off the checkpoint_every
+        # cadence so the resumed run loses nothing, then exit cleanly.
+        preempted_at = completed_round
+        if (
+            config.checkpoint_dir and is_primary
+            and completed_round >= start_round
+        ):
+            forced_path = os.path.join(
+                config.checkpoint_dir, f"round_{completed_round}.ckpt"
+            )
+            if not os.path.exists(forced_path):
+                algo_state = {"prev_metrics": prev_metrics}
+                if hasattr(algorithm, "shapley_values"):
+                    algo_state["shapley_values"] = algorithm.shapley_values
+                if server_state is not None:
+                    algo_state["server_opt_state"] = jax.device_get(
+                        server_state
+                    )
+                save_checkpoint(
+                    forced_path, completed_round, global_params,
+                    client_state, algo_state, key,
+                )
+                gc_checkpoints(
+                    config.checkpoint_dir, config.checkpoint_keep_last
+                )
+            logger.warning(
+                "preempted at round %d (SIGTERM): final checkpoint %s "
+                "written; exiting cleanly — resume with config.resume=True",
+                completed_round, forced_path,
+            )
+        else:
+            logger.warning(
+                "preempted at round %d (SIGTERM): no checkpoint_dir "
+                "configured, exiting cleanly without persisting",
+                completed_round,
+            )
+
     total = time.perf_counter() - t_start
-    n_rounds = config.round - start_round
+    # len(history) counts THIS run's finalized rounds (a preempted run
+    # completes fewer than config.round - start_round).
+    n_rounds = len(history)
     logger.info(
         "finished %d rounds x %d clients in %.2fs (%.1f client-rounds/sec)",
         n_rounds, n_clients, total,
@@ -800,6 +917,14 @@ def run_simulation(
         "client_rounds_per_sec": n_rounds * n_clients / max(total, 1e-9),
         "client_chunk_size": config.client_chunk_size,
         "mesh": mesh,
+        # Robustness telemetry (quorum policy, docs/ROBUSTNESS.md): always
+        # present so downstream consumers (bench.py) need no key checks.
+        "rounds_rejected": telemetry["rounds_rejected"],
+        "mean_survivor_count": (
+            float(np.mean(telemetry["survivor_counts"]))
+            if telemetry["survivor_counts"] else None
+        ),
+        "preempted_at": preempted_at,
     }
 
 

@@ -6,17 +6,46 @@ granular checkpoint of the full simulation state, so long runs survive
 preemption — the failure mode the reference's forever-blocking barrier
 (fed_server.py:75-77) cannot.
 
-Format: a pickle of host (numpy) pytrees — deliberately simple and
+Format: ``b"DLSC"`` magic + little-endian (crc32: u32, payload_len: u64)
+header + a pickle of host (numpy) pytrees — deliberately simple and
 orbax-free to stay stable across jax versions; arrays are materialized with
-``jax.device_get`` before writing.
+``jax.device_get`` before writing. The CRC recorded at save time is
+verified at load (:class:`CheckpointCorruptError` on mismatch/truncation),
+and :func:`load_latest_valid_checkpoint` walks back to the newest VALID
+checkpoint so a write torn by a crash or disk corruption degrades resume
+by one checkpoint interval instead of killing it. Headerless files are
+loaded as legacy (pre-CRC) raw pickles.
+
+Writes are atomic (``.tmp`` + ``os.replace``), so a crashed writer can
+leave a stale ``*.ckpt.tmp`` behind but never a torn ``*.ckpt`` under
+POSIX rename semantics — the CRC exists for everything rename can't
+promise (partial flush on power loss, bit rot, truncation in transit).
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import re
+import struct
+import zlib
 
 import jax
+
+from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+_MAGIC = b"DLSC"
+_HEADER = struct.Struct("<IQ")  # crc32, payload byte length
+# Round-numbered checkpoint files: anything else in checkpoint_dir (a stray
+# `foo.ckpt`, editor droppings) is IGNORED by discovery instead of crashing
+# the resume sort.
+_CKPT_RE = re.compile(r".*_(\d+)\.ckpt$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed integrity verification (truncated header,
+    payload length mismatch, CRC mismatch, or an unreadable legacy pickle).
+    """
 
 
 def save_checkpoint(path: str, round_idx: int, global_params, client_state,
@@ -31,26 +60,141 @@ def save_checkpoint(path: str, round_idx: int, global_params, client_state,
             jax.random.key_data(rng_key)
         ),
     }
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f)
+        f.write(_MAGIC)
+        f.write(_HEADER.pack(zlib.crc32(blob), len(blob)))
+        f.write(blob)
     os.replace(tmp, path)  # atomic: never leaves a torn checkpoint
     return path
 
 
 def load_checkpoint(path: str) -> dict:
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        raw = f.read()
+    if raw[: len(_MAGIC)] == _MAGIC:
+        header_end = len(_MAGIC) + _HEADER.size
+        if len(raw) < header_end:
+            raise CheckpointCorruptError(
+                f"{path}: truncated before the end of the header "
+                f"({len(raw)} bytes)"
+            )
+        crc, length = _HEADER.unpack(raw[len(_MAGIC):header_end])
+        blob = raw[header_end:]
+        if len(blob) != length:
+            raise CheckpointCorruptError(
+                f"{path}: payload truncated ({len(blob)} of {length} bytes)"
+            )
+        if zlib.crc32(blob) != crc:
+            raise CheckpointCorruptError(
+                f"{path}: CRC mismatch (recorded {crc:#010x}, computed "
+                f"{zlib.crc32(blob):#010x})"
+            )
+        try:
+            payload = pickle.loads(blob)
+        except Exception as e:
+            # CRC-valid but unpicklable (e.g. pickle internals changed by a
+            # library upgrade between save and resume): still CORRUPT from
+            # the fallback scan's point of view — warn and walk back, don't
+            # kill the resume.
+            raise CheckpointCorruptError(
+                f"{path}: CRC-valid but unpicklable payload ({e})"
+            ) from e
+    else:
+        # Legacy pre-CRC checkpoint: a raw pickle stream. No integrity
+        # check is possible; an unreadable one still surfaces as corrupt
+        # so the fallback scan can keep walking.
+        try:
+            payload = pickle.loads(raw)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable legacy checkpoint ({e})"
+            ) from e
     if payload.get("rng_key") is not None:
         payload["rng_key"] = jax.random.wrap_key_data(payload["rng_key"])
     return payload
 
 
-def latest_checkpoint(directory: str) -> str | None:
+def checkpoint_rounds(directory: str) -> list[tuple[int, str]]:
+    """``(round, path)`` for every round-numbered checkpoint, ascending."""
     if not os.path.isdir(directory):
-        return None
-    ckpts = [f for f in os.listdir(directory) if f.endswith(".ckpt")]
-    if not ckpts:
-        return None
-    ckpts.sort(key=lambda f: int(f.split("_")[-1].split(".")[0]))
-    return os.path.join(directory, ckpts[-1])
+        return []
+    out = []
+    for f in os.listdir(directory):
+        m = _CKPT_RE.match(f)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, f)))
+    out.sort()
+    return out
+
+
+def sweep_stale_tmps(directory: str) -> list[str]:
+    """Remove ``*.ckpt.tmp`` files a crashed writer left behind.
+
+    Called at resume time: the single-writer discipline (process 0 writes,
+    atomically, one at a time) means any tmp file present when a run
+    STARTS is garbage from a previous incarnation. Best-effort — a tmp
+    that vanishes mid-sweep is already gone.
+    """
+    removed = []
+    if not os.path.isdir(directory):
+        return removed
+    for f in os.listdir(directory):
+        if f.endswith(".ckpt.tmp"):
+            try:
+                os.remove(os.path.join(directory, f))
+                removed.append(f)
+            except OSError:
+                pass
+    if removed:
+        get_logger().info(
+            "removed %d stale checkpoint tmp file(s) left by a crashed "
+            "writer: %s", len(removed), ", ".join(sorted(removed)),
+        )
+    return removed
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    """Read-only discovery — deliberately does NOT sweep tmp files (a
+    monitoring process may call this while a writer is mid-save; the sweep
+    belongs to the resume entry point, before any saves start)."""
+    rounds = checkpoint_rounds(directory)
+    return rounds[-1][1] if rounds else None
+
+
+def load_latest_valid_checkpoint(directory: str) -> tuple[str | None, dict | None]:
+    """Newest checkpoint that passes integrity verification.
+
+    A corrupt/truncated/unreadable candidate is logged and skipped — a
+    torn latest checkpoint costs one checkpoint interval of recomputation
+    instead of the whole run. Returns ``(path, payload)`` or
+    ``(None, None)`` when nothing valid exists.
+    """
+    sweep_stale_tmps(directory)
+    for _, path in reversed(checkpoint_rounds(directory)):
+        try:
+            return path, load_checkpoint(path)
+        except (CheckpointCorruptError, OSError) as e:
+            get_logger().warning(
+                "checkpoint %s failed verification (%s); falling back to "
+                "the previous checkpoint", path, e,
+            )
+    return None, None
+
+
+def gc_checkpoints(directory: str, keep_last: int | None) -> list[str]:
+    """Delete all but the newest ``keep_last`` round-numbered checkpoints
+    (``config.checkpoint_keep_last``; None = keep everything). Runs after
+    each successful save so week-long chaos/preemption runs don't fill the
+    disk. Best-effort removals; returns the deleted paths."""
+    if not keep_last or keep_last < 1:
+        return []
+    removed = []
+    for _, path in checkpoint_rounds(directory)[:-keep_last]:
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed

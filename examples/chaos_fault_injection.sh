@@ -1,0 +1,19 @@
+#!/bin/bash
+# Failure injection + quorum-guarded rounds (docs/ROBUSTNESS.md): 20% of
+# each round's cohort uploads all-NaN parameters (round-correlated: bad
+# rounds cluster), the coordinate-median absorbs them, and any round whose
+# honest survivors fall below the quorum floor — or whose aggregate went
+# non-finite — is REJECTED in-program (previous global retained;
+# rounds_rejected / survivor_count land in every metrics record).
+# CRC-verified checkpoints every 5 rounds, newest 3 kept; on SIGTERM the
+# run finishes its in-flight round, checkpoints, and exits cleanly.
+# Crash-resume bit-exactness proof: python scripts/chaos_resume.py
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name cifar10 --model_name cnn_tpu \
+  --distributed_algorithm fed \
+  --worker_number 100 --round 30 --epoch 1 --learning_rate 0.1 \
+  --momentum 0.9 --batch_size 25 --participation_fraction 0.5 \
+  --failure_mode corrupt_nan --failure_prob 0.2 --failure_correlation 0.5 \
+  --aggregation median --min_survivors 25 \
+  --checkpoint_dir ckpt_chaos --checkpoint_every 5 --checkpoint_keep_last 3 \
+  --log_level INFO

@@ -1,0 +1,147 @@
+"""Chaos harness: crash at a chosen round, resume, assert the stitched
+history is BIT-identical to the uninterrupted run — including cohort
+sampling (participation_fraction < 1) and failure-model draws after
+resume (the checkpointed rng_key chain is what makes this hold)."""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from distributed_learning_simulator_tpu.robustness.chaos import InjectedCrash
+from distributed_learning_simulator_tpu.simulator import run_simulation
+
+_SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "scripts", "chaos_resume.py"
+)
+_spec = importlib.util.spec_from_file_location("chaos_resume", _SCRIPT)
+chaos = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos)
+
+
+def _chaos_config(tmp_path, leg, rounds=5, **overrides):
+    return chaos.chaos_config(str(tmp_path), leg, rounds, **overrides)
+
+
+def _child_env():
+    """Fresh-interpreter env: pin CPU (the conftest pins via jax.config,
+    which a child doesn't inherit) and drop the 8-virtual-device flag."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_inprocess_crash_resume_bit_identical(tmp_path, monkeypatch):
+    straight = chaos.normalize(
+        run_simulation(_chaos_config(tmp_path, "straight"))["history"]
+    )
+    cfg = _chaos_config(
+        tmp_path, "crash",
+        checkpoint_dir=str(tmp_path / "crash" / "ckpt"), checkpoint_every=1,
+    )
+    monkeypatch.setenv("DLS_CRASH_AT_ROUND", "2")
+    monkeypatch.setenv("DLS_CRASH_KIND", "raise")
+    with pytest.raises(InjectedCrash):
+        run_simulation(cfg)
+    monkeypatch.delenv("DLS_CRASH_AT_ROUND")
+    crashed = chaos.read_metrics_jsonl(cfg.log_root)
+    assert crashed, "crashed run flushed no metrics records"
+    resumed = chaos.run_resumed(cfg)
+    verdict = chaos.stitch_and_compare(straight, crashed, resumed)
+    assert verdict["bit_identical"], verdict
+    # The workload's records carry the resume-sensitive telemetry, so
+    # bit-identity above really did compare sampling + failure draws.
+    assert all(
+        "cohort_hash" in r and "survivor_count" in r for r in straight
+    )
+
+
+def test_subprocess_sigkill_resume_bit_identical(tmp_path):
+    straight = chaos.normalize(
+        run_simulation(_chaos_config(tmp_path, "straight"))["history"]
+    )
+    # checkpoint_every=2 with the kill at round 2: resume must also
+    # bit-exactly REPLAY a round past the newest surviving checkpoint.
+    cfg = _chaos_config(
+        tmp_path, "sigkill",
+        checkpoint_dir=str(tmp_path / "sigkill" / "ckpt"), checkpoint_every=2,
+    )
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, "--child",
+         "--config", json.dumps(vars(cfg))],
+        env={**_child_env(), "DLS_CRASH_AT_ROUND": "2",
+             "DLS_CRASH_KIND": "sigkill"},
+        capture_output=True, text=True, timeout=420,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr)
+    crashed = chaos.read_metrics_jsonl(cfg.log_root)
+    assert crashed, "SIGKILLed run flushed no metrics records"
+    resumed = chaos.run_resumed(cfg)
+    verdict = chaos.stitch_and_compare(straight, crashed, resumed)
+    assert verdict["bit_identical"], verdict
+
+
+def test_sigterm_grace_checkpoint_and_resume(tmp_path):
+    """SIGTERM (TPU preemption notice): finish the in-flight round, write a
+    final checkpoint even with checkpoint_every=0, log 'preempted at round
+    N', exit 0 — and the resumed tail must match the straight run."""
+    straight = chaos.normalize(
+        run_simulation(_chaos_config(tmp_path, "straight"))["history"]
+    )
+    ckpt_dir = tmp_path / "sigterm" / "ckpt"
+    cfg = _chaos_config(
+        tmp_path, "sigterm",
+        checkpoint_dir=str(ckpt_dir), checkpoint_every=0,
+    )
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, "--child",
+         "--config", json.dumps(vars(cfg))],
+        env={**_child_env(), "DLS_CRASH_AT_ROUND": "2",
+             "DLS_CRASH_KIND": "sigterm"},
+        capture_output=True, text=True, timeout=420,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-800:])
+    assert "preempted at round" in proc.stderr
+    child_result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert child_result["preempted_at"] is not None
+    # checkpoint_every=0: the ONLY checkpoint is the forced preemption one.
+    ckpts = [f for f in os.listdir(ckpt_dir) if f.endswith(".ckpt")]
+    assert ckpts == [f"round_{child_result['preempted_at']}.ckpt"]
+    crashed = chaos.read_metrics_jsonl(cfg.log_root)
+    resumed = chaos.run_resumed(cfg)
+    verdict = chaos.stitch_and_compare(straight, crashed, resumed)
+    assert verdict["bit_identical"], verdict
+
+
+def test_cohort_sampling_resume_determinism(tiny_config, tmp_path):
+    """With participation_fraction < 1 and no failure model, the per-round
+    sampled cohorts after resume must match the uninterrupted run — the
+    rng_key checkpoint path the chaos harness depends on."""
+    base = dataclasses.replace(tiny_config, participation_fraction=0.5,
+                               worker_number=6)
+    straight = run_simulation(
+        dataclasses.replace(base, round=4), setup_logging=False
+    )
+    ckdir = str(tmp_path / "ck")
+    run_simulation(
+        dataclasses.replace(base, round=2, checkpoint_dir=ckdir,
+                            checkpoint_every=1),
+        setup_logging=False,
+    )
+    resumed = run_simulation(
+        dataclasses.replace(base, round=4, checkpoint_dir=ckdir, resume=True),
+        setup_logging=False,
+    )
+    straight_hashes = [h["cohort_hash"] for h in straight["history"]]
+    resumed_hashes = [h["cohort_hash"] for h in resumed["history"]]
+    assert resumed_hashes == straight_hashes[2:]
+    assert [h["test_accuracy"] for h in resumed["history"]] == [
+        h["test_accuracy"] for h in straight["history"][2:]
+    ]

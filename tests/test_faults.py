@@ -1,0 +1,309 @@
+"""Failure-model subsystem: mask statistics, corruption semantics, survivor
+renormalization across both FedAvg execution paths, quorum rejection, and
+the algorithm-level refusals (docs/ROBUSTNESS.md)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.robustness.faults import (
+    CORRUPT_SCALE,
+    FailureModel,
+)
+from distributed_learning_simulator_tpu.simulator import run_simulation
+
+
+def _fm(mode="dropout", prob=0.3, correlation=0.0, seed=0):
+    return FailureModel(mode=mode, prob=prob, correlation=correlation,
+                        seed=seed)
+
+
+def test_from_config_inactive_when_none_or_zero_prob():
+    assert FailureModel.from_config(ExperimentConfig()) is None
+    assert FailureModel.from_config(
+        ExperimentConfig(failure_mode="dropout", failure_prob=0.0)
+    ) is None
+    assert FailureModel.from_config(
+        ExperimentConfig(failure_mode="dropout", failure_prob=0.5)
+    ) is not None
+
+
+def test_failure_mask_marginal_rate():
+    fm = _fm(prob=0.3)
+    draws = jax.vmap(lambda k: fm.draw_failed(k, 64))(
+        jax.random.split(jax.random.key(0), 200)
+    )
+    rate = float(jnp.mean(draws))
+    assert abs(rate - 0.3) < 0.02
+
+
+def test_failure_correlation_one_is_all_or_nothing():
+    fm = _fm(prob=0.3, correlation=1.0)
+    draws = np.asarray(jax.vmap(lambda k: fm.draw_failed(k, 32))(
+        jax.random.split(jax.random.key(1), 100)
+    ))
+    per_round = draws.mean(axis=1)
+    assert set(np.unique(per_round)) <= {0.0, 1.0}
+    assert abs(per_round.mean() - 0.3) < 0.15
+
+
+def test_failure_seed_rerolls_mask():
+    key = jax.random.key(2)
+    a = np.asarray(_fm(seed=0, prob=0.5).draw_failed(key, 256))
+    b = np.asarray(_fm(seed=1, prob=0.5).draw_failed(key, 256))
+    assert (a != b).any()
+    # same seed = same draw (resume determinism at the op level)
+    c = np.asarray(_fm(seed=0, prob=0.5).draw_failed(key, 256))
+    assert (a == c).all()
+
+
+def test_corrupt_stack_modes():
+    stack = {"w": jnp.ones((4, 3)), "b": jnp.arange(8.0).reshape(4, 2)}
+    failed = jnp.asarray([True, False, True, False])
+    nan = _fm("corrupt_nan").corrupt_stack(stack, failed)
+    assert np.isnan(np.asarray(nan["w"][0])).all()
+    assert np.isnan(np.asarray(nan["b"][2])).all()
+    assert (np.asarray(nan["w"][1]) == 1.0).all()
+    scaled = _fm("corrupt_scale").corrupt_stack(stack, failed)
+    assert np.allclose(np.asarray(scaled["w"][0]), CORRUPT_SCALE)
+    assert np.allclose(np.asarray(scaled["b"][3]), np.asarray(stack["b"][3]))
+
+
+def test_validate_rejections():
+    with pytest.raises(ValueError, match="failure_mode"):
+        ExperimentConfig(failure_mode="lightning").validate()
+    with pytest.raises(ValueError, match="failure_prob"):
+        ExperimentConfig(failure_mode="dropout", failure_prob=1.5).validate()
+    with pytest.raises(ValueError, match="min_survivors"):
+        ExperimentConfig(worker_number=4, min_survivors=5).validate()
+    with pytest.raises(ValueError, match="threaded"):
+        ExperimentConfig(
+            execution_mode="threaded",
+            failure_mode="dropout", failure_prob=0.1,
+        ).validate()
+    with pytest.raises(ValueError, match="checkpoint_keep_last"):
+        ExperimentConfig(checkpoint_keep_last=0).validate()
+
+
+def test_signsgd_rejects_corrupt_modes(tiny_config):
+    from distributed_learning_simulator_tpu.factory import get_algorithm
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="sign_SGD",
+        failure_mode="corrupt_nan", failure_prob=0.2,
+    )
+    with pytest.raises(ValueError, match="dropout/straggler"):
+        get_algorithm("sign_SGD", cfg)
+
+
+@pytest.mark.parametrize(
+    "algo", ["multiround_shapley_value", "GTG_shapley_value"]
+)
+def test_shapley_constructor_refuses_failures(tiny_config, algo):
+    from distributed_learning_simulator_tpu.factory import get_algorithm
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm=algo,
+        failure_mode="straggler", failure_prob=0.2,
+    )
+    with pytest.raises(ValueError, match="fixed cohort"):
+        get_algorithm(algo, cfg)
+
+
+def test_corrupt_nan_median_quorum_end_to_end(tiny_config):
+    """Acceptance: corrupt_nan + median + quorum finishes with finite
+    accuracy and nonzero survivor_count telemetry."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=3,
+        failure_mode="corrupt_nan", failure_prob=0.4,
+        aggregation="median", min_survivors=3,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    assert np.isfinite(r["final_accuracy"])
+    assert all(np.isfinite(h["test_accuracy"]) for h in r["history"])
+    assert all("survivor_count" in h for h in r["history"])
+    assert any(h["survivor_count"] > 0 for h in r["history"])
+    assert r["mean_survivor_count"] > 0
+
+
+def test_corrupt_nan_plain_mean_quorum_rejects_not_propagates(tiny_config):
+    """Acceptance: under the plain mean, any round where a corrupt upload
+    would have produced a non-finite aggregate is REJECTED (previous
+    global retained) instead of NaN-propagating into every later round."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=4,
+        failure_mode="corrupt_nan", failure_prob=0.5,
+        aggregation="mean", min_survivors=1,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    # A NaN upload makes the plain-mean aggregate all-NaN, so rejection is
+    # exactly "some client was corrupt this round".
+    for h in r["history"]:
+        assert h["round_rejected"] == (h["survivor_count"] < 8)
+    assert r["rounds_rejected"] >= 1, "prob=0.5 x 8 clients x 4 rounds"
+    assert all(np.isfinite(h["test_accuracy"]) for h in r["history"])
+    finite = all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree_util.tree_leaves(r["global_params"])
+    )
+    assert finite
+    # A rejected round keeps the previous global model, so its eval is
+    # bit-identical to the previous round's.
+    hist = r["history"]
+    for prev, cur in zip(hist, hist[1:]):
+        if cur["round_rejected"]:
+            assert cur["test_accuracy"] == prev["test_accuracy"]
+            assert cur["test_loss"] == prev["test_loss"]
+
+
+@pytest.mark.parametrize("mode", ["dropout", "corrupt_scale"])
+def test_fused_and_materializing_paths_agree(tiny_config, mode):
+    """The fused (chunked partial-sum) path and the materializing path
+    (client_eval forces the full stack) must inject the SAME faults:
+    dropout via zeroed weights, corruption on the raw pre-payload upload."""
+    base = dataclasses.replace(
+        tiny_config, round=2, failure_mode=mode, failure_prob=0.4,
+        min_survivors=0,
+    )
+    fused = run_simulation(
+        dataclasses.replace(base, client_eval=False), setup_logging=False
+    )
+    materialized = run_simulation(
+        dataclasses.replace(base, client_eval=True), setup_logging=False
+    )
+    for a, b in zip(fused["history"], materialized["history"]):
+        assert a["survivor_count"] == b["survivor_count"]
+        assert np.isclose(a["test_accuracy"], b["test_accuracy"])
+        assert np.isclose(a["test_loss"], b["test_loss"], rtol=1e-5)
+    ga = jax.tree_util.tree_leaves(fused["global_params"])
+    gb = jax.tree_util.tree_leaves(materialized["global_params"])
+    for la, lb in zip(ga, gb):
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_dropout_vs_straggler_state_semantics(tiny_config):
+    """With persistent client optimizers, dropout freezes a failed
+    client's state (it never trained) while a straggler's advances (it
+    trained; only the upload was lost). prob=1 makes every client fail
+    every round, so the distinction is directly observable."""
+    base = dataclasses.replace(
+        tiny_config, round=2, momentum=0.9, reset_client_optimizer=False,
+        failure_prob=1.0, failure_mode="dropout",
+    )
+    dropped = run_simulation(base, setup_logging=False)
+    momenta = [
+        np.asarray(leaf)
+        for leaf in jax.tree_util.tree_leaves(dropped["client_state"])
+        if np.asarray(leaf).dtype == np.float32
+    ]
+    assert all((m == 0).all() for m in momenta), "dropout must freeze state"
+    straggled = run_simulation(
+        dataclasses.replace(base, failure_mode="straggler"),
+        setup_logging=False,
+    )
+    s_momenta = [
+        np.asarray(leaf)
+        for leaf in jax.tree_util.tree_leaves(straggled["client_state"])
+        if np.asarray(leaf).dtype == np.float32
+    ]
+    assert any((m != 0).any() for m in s_momenta), (
+        "straggler state must advance"
+    )
+    # Either way nobody's update landed: the global model never moved.
+    for r in (dropped, straggled):
+        accs = [h["test_accuracy"] for h in r["history"]]
+        assert len(set(accs)) == 1
+
+
+def test_signsgd_dropout_excludes_votes_and_freezes_state(tiny_config):
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="sign_SGD", learning_rate=0.01,
+        momentum=0.9, round=2,
+        failure_mode="dropout", failure_prob=1.0, failure_correlation=1.0,
+        min_survivors=1,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    # Everyone failed every round: all rounds rejected, no step taken.
+    assert r["rounds_rejected"] == 2
+    assert all(h["survivor_count"] == 0 for h in r["history"])
+    state = r["client_state"]
+    assert (np.asarray(state["steps"]) == 0).all()
+    assert all(
+        (np.asarray(leaf) == 0).all()
+        for leaf in jax.tree_util.tree_leaves(state["momenta"])
+    )
+    accs = [h["test_accuracy"] for h in r["history"]]
+    assert len(set(accs)) == 1
+
+
+def test_rejected_round_frozen_under_server_optimizer(tiny_config):
+    """A rejected round must retain the previous global EXACTLY even with
+    a server optimizer: the pseudo-gradient is 0, but an unguarded
+    momentum trace from prior rounds would still move the params and
+    advance the optimizer state."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=4,
+        failure_mode="corrupt_nan", failure_prob=0.5,
+        aggregation="mean", min_survivors=1,
+        server_optimizer_name="sgd", server_learning_rate=1.0,
+        server_momentum=0.9,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    assert r["rounds_rejected"] >= 1
+    hist = r["history"]
+    for prev, cur in zip(hist, hist[1:]):
+        if cur["round_rejected"]:
+            assert cur["test_accuracy"] == prev["test_accuracy"]
+            assert cur["test_loss"] == prev["test_loss"]
+    assert all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree_util.tree_leaves(r["global_params"])
+    )
+
+
+def test_rejected_round_frozen_under_fed_quant_downlink(tiny_config):
+    """fed_quant re-quantizes every broadcast; on a REJECTED round the
+    retained model must skip that (fresh quantization noise would move
+    the 'retained' params)."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="fed_quant", worker_number=8,
+        round=4, failure_mode="corrupt_nan", failure_prob=0.5,
+        aggregation="median", min_survivors=7,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    assert r["rounds_rejected"] >= 1
+    hist = r["history"]
+    for prev, cur in zip(hist, hist[1:]):
+        if cur["round_rejected"]:
+            assert cur["test_accuracy"] == prev["test_accuracy"]
+            assert cur["test_loss"] == prev["test_loss"]
+
+
+def test_failure_free_history_unchanged_by_feature(tiny_config):
+    """failure_mode='none' must keep the pre-feature RNG streams: the
+    quorum/telemetry machinery is entirely trace-time gated."""
+    a = run_simulation(tiny_config, setup_logging=False)
+    assert "survivor_count" not in a["history"][0]
+    assert "round_rejected" not in a["history"][0]
+    assert a["rounds_rejected"] == 0
+    assert a["mean_survivor_count"] is None
+    # min_survivors alone (no failure model) activates the quorum guard
+    # with the full cohort surviving every round.
+    b = run_simulation(
+        dataclasses.replace(tiny_config, min_survivors=2),
+        setup_logging=False,
+    )
+    assert all(
+        h["survivor_count"] == tiny_config.worker_number
+        and not h["round_rejected"]
+        for h in b["history"]
+    )
+    assert [h["test_accuracy"] for h in a["history"]] == [
+        h["test_accuracy"] for h in b["history"]
+    ]
