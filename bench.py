@@ -18,7 +18,10 @@ runs, docs/PERFORMANCE.md). The median is stable against those spikes —
 that is the regression signal. The mean-based rate and the per-round spread
 are reported alongside for auditability.
 
-Prints ONE JSON line. Env overrides: BENCH_CLIENTS, BENCH_ROUNDS,
+Prints ONE JSON line, provenance-stamped with ``schema_version`` +
+``config_hash`` (utils/reporting.py) so ``scripts/compare_bench.py`` can
+refuse to diff incomparable runs and gate the tracked metrics against
+regressions (docs/OBSERVABILITY.md). Env overrides: BENCH_CLIENTS, BENCH_ROUNDS,
 BENCH_MODEL, BENCH_BATCH, BENCH_CHUNK (client_chunk_size), BENCH_DTYPE
 (local_compute_dtype). BENCH_FAILURE_MODE/BENCH_FAILURE_PROB/
 BENCH_MIN_SURVIVORS activate a failure model on the headline leg and add
@@ -194,8 +197,19 @@ def main():
     times, result = _run(config, dataset=dataset, client_data=client_data)
     r = _rates(times, n_clients)
 
+    from distributed_learning_simulator_tpu.utils.reporting import (
+        BENCH_SCHEMA_VERSION,
+        config_hash,
+    )
+
     north_star = 1000 * 100 / 300.0  # 333.3 clients*rounds/sec on v5e-8
     record = {
+        # Provenance stamp (utils/reporting.py): schema_version + a hash
+        # of the program-defining config knobs, so compare_bench.py can
+        # refuse to diff runs whose numbers are not comparable (different
+        # model/population/chunk/dtype/failure knobs).
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "config_hash": config_hash(config),
         "metric": "simulated_clients_x_rounds_per_sec",
         "value": round(r["median_rate"], 2),
         "unit": "clients*rounds/s",

@@ -15,6 +15,12 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any
 
+# Valid telemetry_level values (semantics: telemetry/ and
+# docs/OBSERVABILITY.md). Defined here — not in the telemetry package —
+# so validate() stays import-light (telemetry's submodules import jax);
+# the package re-exports it.
+TELEMETRY_LEVELS = ("off", "basic", "detailed")
+
 
 @dataclass
 class ExperimentConfig:
@@ -259,6 +265,20 @@ class ExperimentConfig:
     # disabled for algorithms whose post_round needs same-round metrics
     # (Shapley) and when per-client state must be checkpointed.
     pipeline_rounds: bool = True
+    # --- telemetry (telemetry/; docs/OBSERVABILITY.md) ----------------------
+    # "off" (default): zero instrumentation — metrics.jsonl keeps the
+    # legacy v1 record layout byte-for-byte and the measured program is
+    # untouched. "basic": per-round phase timings (monotonic clocks around
+    # the dispatch sites; JAX dispatch is async, so device time pools into
+    # the host_sync phase), XLA recompile counts with offending function
+    # names (any compile after the warmup round is flagged as a
+    # shape-instability WARNING), and the per-round peak-HBM watermark —
+    # recorded under a schema-versioned "telemetry" sub-object in
+    # metrics.jsonl. "detailed": same fields, but every phase fences on
+    # its output (block_until_ready) so the split is true per-phase device
+    # time; fencing defeats round pipelining's transfer/compute overlap —
+    # a measurement mode, not a production mode.
+    telemetry_level: str = "off"
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
     # First round the profile trace covers (earlier rounds run untraced).
@@ -443,6 +463,11 @@ class ExperimentConfig:
             raise ValueError(
                 "gtg_prefix_mode must be 'cumsum' or 'masked', got "
                 f"{self.gtg_prefix_mode!r}"
+            )
+        if self.telemetry_level.lower() not in TELEMETRY_LEVELS:
+            raise ValueError(
+                f"unknown telemetry_level {self.telemetry_level!r}; known: "
+                + ", ".join(TELEMETRY_LEVELS)
             )
         if self.profile_from_round < 0:
             raise ValueError(

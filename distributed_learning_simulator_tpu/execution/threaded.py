@@ -64,7 +64,15 @@ from distributed_learning_simulator_tpu.runtime.native import (
     NativeTaskQueue,
     NativeThreadPool,
 )
+from distributed_learning_simulator_tpu.telemetry import (
+    RecompileMonitor,
+    make_phase_timer,
+    peak_hbm_bytes,
+)
 from distributed_learning_simulator_tpu.utils.logging import get_logger
+from distributed_learning_simulator_tpu.utils.reporting import (
+    build_round_record,
+)
 
 
 class _QueueServerBase:
@@ -84,12 +92,35 @@ class _QueueServerBase:
 
     def _init_queues(self) -> None:
         self.server_error: BaseException | None = None
+        # Run telemetry (docs/OBSERVABILITY.md): the serve thread times its
+        # aggregate/eval/post_round work per round, same phase vocabulary
+        # as the vmap path ('client_step' has no server-side analogue here
+        # — local training runs on the worker threads).
+        self._phase_timer = make_phase_timer(self.config.telemetry_level)
         self.result_queues = [
             NativeTaskQueue() for _ in range(self.worker_number)
         ]
         self.worker_data_queue = NativeTaskQueue(
             worker_fun=self._guarded_worker_fun
         )
+
+    def _finish_record(self, record: dict, round_idx: int) -> dict:
+        """Fold the round's telemetry into the metrics record through the
+        shared schema-versioned builder (utils/reporting.py); at
+        telemetry_level='off' the legacy v1 record passes through
+        unchanged."""
+        if not self._phase_timer.enabled:
+            return record
+        tel = {
+            "phase_seconds": {
+                k: round(v, 6)
+                for k, v in sorted(self._phase_timer.take(round_idx).items())
+            },
+        }
+        peak = peak_hbm_bytes()
+        if peak is not None:
+            tel["peak_hbm_bytes"] = peak
+        return build_round_record(record, tel)
 
     def _guarded_worker_fun(self, data, extra_args):
         """Server-callback errors must tear the rendezvous down, not kill
@@ -179,44 +210,55 @@ class ThreadedServer(_QueueServerBase):
         )
         if len(self._buffer) < self.worker_number:
             return None  # barrier: wait for all clients (fed_server.py:75-77)
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs),
-            *[self._buffer[i][1] for i in range(self.worker_number)],
-        )
-        sizes = jnp.asarray(
-            [self._buffer[i][0] for i in range(self.worker_number)],
-            dtype=jnp.float32,
-        )
-        aggregated = aggregate(
-            stacked, sizes, self.config.aggregation, self.config.trim_ratio
-        )
-        if self.config.aggregation.lower() != "mean":
-            # Same finite-or-previous-model guard as the vmap path
-            # (fedavg.py round_fn): an all-diverged cohort must not poison
-            # the global model — the two execution modes are a differential
-            # oracle pair and must agree in exactly these scenarios. One
-            # fused reduction + one device sync (a per-leaf bool() would
-            # pay L round-trips per round, and params are normally finite
-            # so every leaf would be fetched).
-            finite = bool(jnp.all(jnp.stack([
-                jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
-                for leaf in jax.tree_util.tree_leaves(aggregated)
-            ])))
-            if not finite:
-                aggregated = self.prev_model
-        aggregated = self._process_aggregated_parameter(aggregated)
-        metrics = {
-            k: float(v)
-            for k, v in self._evaluate(aggregated, *self._eval_batches).items()
-        }
+        with self._phase_timer.phase(self._round, "aggregate") as _ph:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *[self._buffer[i][1] for i in range(self.worker_number)],
+            )
+            sizes = jnp.asarray(
+                [self._buffer[i][0] for i in range(self.worker_number)],
+                dtype=jnp.float32,
+            )
+            aggregated = aggregate(
+                stacked, sizes, self.config.aggregation, self.config.trim_ratio
+            )
+            if self.config.aggregation.lower() != "mean":
+                # Same finite-or-previous-model guard as the vmap path
+                # (fedavg.py round_fn): an all-diverged cohort must not
+                # poison the global model — the two execution modes are a
+                # differential oracle pair and must agree in exactly these
+                # scenarios. One fused reduction + one device sync (a
+                # per-leaf bool() would pay L round-trips per round, and
+                # params are normally finite so every leaf would be
+                # fetched).
+                finite = bool(jnp.all(jnp.stack([
+                    jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+                    for leaf in jax.tree_util.tree_leaves(aggregated)
+                ])))
+                if not finite:
+                    aggregated = self.prev_model
+            aggregated = self._process_aggregated_parameter(aggregated)
+            _ph.fence(aggregated)
+        with self._phase_timer.phase(self._round, "eval"):
+            # float() blocks on the device values, so the phase needs no
+            # explicit fence even under 'detailed'.
+            metrics = {
+                k: float(v)
+                for k, v in self._evaluate(
+                    aggregated, *self._eval_batches
+                ).items()
+            }
+        with self._phase_timer.phase(self._round, "post_round"):
+            extra_post = self._post_round(stacked, sizes, aggregated, metrics)
         record = {
             "round": self._round,
             "test_accuracy": metrics["accuracy"],
             "test_loss": metrics["loss"],
             "round_seconds": time.perf_counter() - self._round_t0,
             **self._record_extra(aggregated),
-            **self._post_round(stacked, sizes, aggregated, metrics),
+            **extra_post,
         }
+        record = self._finish_record(record, self._round)
         self.history.append(record)
         if self.metrics_path:
             with open(self.metrics_path, "a") as f:
@@ -444,24 +486,31 @@ class ThreadedSignSGDServer(_QueueServerBase):
         self._buffer[worker_id] = signs
         if len(self._buffer) < self.worker_number:
             return None  # barrier: every step waits for all N workers
-        # Majority vote: elementwise sign of the summed signs.
-        voted = jax.tree_util.tree_map(
-            lambda *xs: np.sign(np.sum(np.stack(xs), axis=0)),
-            *[self._buffer[i] for i in range(self.worker_number)],
-        )
-        self._buffer.clear()
-        self.params = self._apply_vote(
-            self.params, jax.tree_util.tree_map(jnp.asarray, voted)
-        )
+        # Per-step vote + apply accumulate into the CURRENT round's
+        # 'aggregate' phase (sign_SGD aggregates per optimizer step, so
+        # the round's phase time is the sum of its steps' votes).
+        with self._phase_timer.phase(
+                self._step // self._steps_per_round, "aggregate") as _ph:
+            # Majority vote: elementwise sign of the summed signs.
+            voted = jax.tree_util.tree_map(
+                lambda *xs: np.sign(np.sum(np.stack(xs), axis=0)),
+                *[self._buffer[i] for i in range(self.worker_number)],
+            )
+            self._buffer.clear()
+            self.params = self._apply_vote(
+                self.params, jax.tree_util.tree_map(jnp.asarray, voted)
+            )
+            _ph.fence(self.params)
         self._step += 1
         if self._step % self._steps_per_round == 0:
             round_idx = self._step // self._steps_per_round - 1
-            metrics = {
-                k: float(v)
-                for k, v in self._evaluate(
-                    self.params, *self._eval_batches
-                ).items()
-            }
+            with self._phase_timer.phase(round_idx, "eval"):
+                metrics = {
+                    k: float(v)
+                    for k, v in self._evaluate(
+                        self.params, *self._eval_batches
+                    ).items()
+                }
             from distributed_learning_simulator_tpu.ops.payload import (
                 compression_ratio,
                 payload_bytes,
@@ -479,6 +528,7 @@ class ThreadedSignSGDServer(_QueueServerBase):
                 ),
                 "sync_steps": self._steps_per_round,
             }
+            record = self._finish_record(record, round_idx)
             self.history.append(record)
             if self.metrics_path:
                 with open(self.metrics_path, "a") as f:
@@ -701,6 +751,14 @@ def run_threaded_simulation(
         )
     )
 
+    # Run-scoped recompile counter (docs/OBSERVABILITY.md): worker threads
+    # share ONE jitted local_train, so a healthy run compiles each program
+    # once total; per-round attribution is meaningless here (threads
+    # compile concurrently), so the count is reported once at the end.
+    recompile = (
+        RecompileMonitor().start()
+        if config.telemetry_level.lower() != "off" else None
+    )
     t_start = time.perf_counter()
     if algo_name == "sign_SGD":
         server, make_worker = _build_sign_sgd(
@@ -812,6 +870,8 @@ def run_threaded_simulation(
         # still blocked in get_result only unblocks once the queues stop.
         server.stop()
         pool.stop()
+        if recompile is not None:
+            recompile.stop()
     if server.server_error is not None:
         # The FINAL round's aggregation/eval runs on the serve thread after
         # every worker has already exited (workers end on add_task, not a
@@ -821,6 +881,15 @@ def run_threaded_simulation(
         # with the last round's record silently missing.
         raise server.server_error
     total = time.perf_counter() - t_start
+    xla_compiles = None
+    if recompile is not None:
+        events = recompile.drain()
+        xla_compiles = len(events)
+        get_logger().info(
+            "threaded run: %d XLA compile(s) total: %s",
+            xla_compiles,
+            ", ".join(sorted({name for name, _ in events})) or "-",
+        )
     history = server.history
     n = client_data.n_clients
     final_params = (
@@ -832,6 +901,8 @@ def run_threaded_simulation(
         "final_accuracy": history[-1]["test_accuracy"] if history else None,
         "total_seconds": total,
         "client_rounds_per_sec": config.round * n / max(total, 1e-9),
+        "telemetry_level": config.telemetry_level.lower(),
+        "xla_compiles": xla_compiles,
     }
 
 

@@ -361,7 +361,8 @@ def pad_eval_set(x, y, batch_size: int, flatten: bool = False):
     )
 
 
-def make_eval_fn(apply_fn, preprocess: Callable | None = None):
+def make_eval_fn(apply_fn, preprocess: Callable | None = None,
+                 name: str = "evaluate"):
     """Build ``evaluate(params, xb, yb, mb) -> {"loss", "accuracy"}``.
 
     Full-test-set inference as a scan over pre-padded batches; parity with the
@@ -369,6 +370,12 @@ def make_eval_fn(apply_fn, preprocess: Callable | None = None):
     ``tester.inference()``, fed_server.py:26-32,85-86). vmap-able over a
     params batch for Shapley subset evaluation. ``preprocess`` is applied to
     each x batch inside the scan (e.g. ``make_reshaper`` for flat storage).
+
+    ``name`` becomes the jitted program's display name (compile logs, the
+    telemetry recompile counter, profiler traces): several distinct
+    programs are built from this factory per run (server eval, Shapley
+    subset eval), and an anonymous shared "evaluate" would make a
+    recompile warning unattributable.
     """
     def evaluate(params, xb, yb, mb):
         def body(carry, batch):
@@ -392,4 +399,5 @@ def make_eval_fn(apply_fn, preprocess: Callable | None = None):
         count = jnp.maximum(count, 1.0)
         return {"loss": loss_sum / count, "accuracy": correct_sum / count}
 
+    evaluate.__name__ = evaluate.__qualname__ = name
     return evaluate

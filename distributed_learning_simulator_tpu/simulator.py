@@ -56,6 +56,16 @@ from distributed_learning_simulator_tpu.parallel.mesh import (
     shard_client_data,
 )
 from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
+from distributed_learning_simulator_tpu.telemetry import (
+    RecompileMonitor,
+    hbm_limit_bytes,
+    log_round_compiles,
+    make_phase_timer,
+    peak_hbm_bytes,
+)
+from distributed_learning_simulator_tpu.utils.reporting import (
+    build_round_record,
+)
 from distributed_learning_simulator_tpu.utils.errors import is_device_oom
 from distributed_learning_simulator_tpu.utils.checkpoint import (
     gc_checkpoints,
@@ -86,12 +96,7 @@ def _device_budget_bytes(config) -> float:
     16 GB fallback when the plugin doesn't report memory stats. The ONE
     copy of the budget model shared by the chunk auto-sizer, the OOM hint,
     and the materializing-path feasibility refusal."""
-    hbm = 16 * 1024**3
-    try:
-        stats = jax.devices()[0].memory_stats()
-        hbm = stats.get("bytes_limit", hbm) or hbm
-    except Exception:
-        pass
+    hbm = hbm_limit_bytes() or 16 * 1024**3
     return 0.6 * hbm * (config.mesh_devices or 1)
 
 
@@ -402,7 +407,9 @@ def run_simulation(
             "lr_schedule (its round program takes no lr_scale operand)"
         )
 
-    evaluate = jax.jit(make_eval_fn(model.apply, preprocess=eval_preprocess))
+    evaluate = jax.jit(make_eval_fn(
+        model.apply, preprocess=eval_preprocess, name="server_eval"
+    ))
     algorithm.prepare(
         model.apply, make_eval_fn(model.apply, preprocess=eval_preprocess)
     )
@@ -634,6 +641,13 @@ def run_simulation(
     # and quorum rejections, accumulated for the result dict so callers
     # (and bench.py) can't silently trade robustness for speed.
     telemetry = {"rounds_rejected": 0, "survivor_counts": []}
+    # Run telemetry (telemetry/; docs/OBSERVABILITY.md): phase timing,
+    # recompile counting, HBM watermark. At the default 'off' both hooks
+    # are inert and the metrics records stay in the legacy v1 layout.
+    tel_level = config.telemetry_level.lower()
+    phase_timer = make_phase_timer(tel_level)
+    recompile = RecompileMonitor() if tel_level != "off" else None
+    post_warmup_compiles = {"count": 0} if recompile is not None else None
 
     def finalize(p: dict) -> None:
         nonlocal prev_metrics, t_prev_done
@@ -641,8 +655,9 @@ def run_simulation(
             k for k in ("survivor_count", "round_rejected", "participants")
             if k in p["aux"]
         ]
-        with _oom_hint(config, p["new_global"], n_clients,
-                       site="deferred metric fetch"):
+        with phase_timer.phase(p["round_idx"], "host_sync"), _oom_hint(
+                config, p["new_global"], n_clients,
+                site="deferred metric fetch"):
             fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
                 (p["metrics_dev"], p["mean_loss_dev"],
                  {k: p["aux"][k] for k in tel_keys})
@@ -659,7 +674,8 @@ def run_simulation(
             eval_batches=eval_batches,
             log_dir=log_dir,
         )
-        with annotate("post_round"):
+        with annotate("post_round"), phase_timer.phase(
+                p["round_idx"], "post_round"):
             extra = algorithm.post_round(ctx) or {}
         now = time.perf_counter()
         record = {
@@ -702,6 +718,35 @@ def run_simulation(
                 ).tobytes()
             )
         t_prev_done = now
+        if phase_timer.enabled:
+            # Attribute post_round/host-side compiles, then fold this
+            # round's telemetry into a schema-v2 record (shared builder:
+            # utils/reporting.py). Warmup = the first EXECUTED round (it
+            # legitimately compiles the round + eval programs); anything
+            # later is the shape-instability warning.
+            recompile.attribute(p["round_idx"])
+            events = recompile.take(p["round_idx"])
+            n_compiles = log_round_compiles(
+                logger, p["round_idx"], events,
+                warmup=p["round_idx"] == start_round,
+            )
+            if p["round_idx"] > start_round:
+                post_warmup_compiles["count"] += n_compiles
+            tel_rec = {
+                "phase_seconds": {
+                    k: round(v, 6)
+                    for k, v in sorted(
+                        phase_timer.take(p["round_idx"]).items()
+                    )
+                },
+                "compiles": n_compiles,
+            }
+            if events:
+                tel_rec["compiled"] = [name for name, _ in events]
+            peak = peak_hbm_bytes()
+            if peak is not None:
+                tel_rec["peak_hbm_bytes"] = peak
+            record = build_round_record(record, tel_rec)
         history.append(record)
         if metrics_path:
             with open(metrics_path, "a") as f:
@@ -760,6 +805,11 @@ def run_simulation(
     completed_round = start_round - 1
     preempted_at = None
     with ExitStack() as profile_stack:
+        if recompile is not None:
+            # Scoped to the round loop: the monitor owns process-global
+            # logging state (jax_log_compiles + compile-logger capture),
+            # restored on exit even if a round raises.
+            profile_stack.enter_context(recompile)
         if config.profile_dir and profile_from <= start_round:
             profile_stack.enter_context(profile_session(config.profile_dir))
             profile_from = None  # entered
@@ -796,10 +846,12 @@ def run_simulation(
                     lr_args = () if config.lr_schedule.lower() == (
                         "constant"
                     ) else (jnp.float32(_lr_factor(config, round_idx)),)
-                    new_global, client_state, aux = round_jit(
-                        global_params, client_state, cx, cy, cmask, sizes,
-                        round_key, *lr_args,
-                    )
+                    with phase_timer.phase(round_idx, "client_step") as _ph:
+                        new_global, client_state, aux = round_jit(
+                            global_params, client_state, cx, cy, cmask, sizes,
+                            round_key, *lr_args,
+                        )
+                        _ph.fence((new_global, aux))
                     if server_update_jit is not None:
                         # When the round program carries a quorum verdict,
                         # the server optimizer must see it: a rejected
@@ -809,11 +861,24 @@ def run_simulation(
                         srv_args = (global_params, new_global, server_state)
                         if "round_rejected" in aux:
                             srv_args += (aux["round_rejected"],)
-                        new_global, server_state = server_update_jit(*srv_args)
+                        with phase_timer.phase(
+                                round_idx, "aggregate") as _ph:
+                            new_global, server_state = server_update_jit(
+                                *srv_args
+                            )
+                            _ph.fence(new_global)
                 with annotate("server_eval"), _oom_hint(
                     config, global_params, n_clients, site="eval"
                 ):
-                    metrics_dev = evaluate(new_global, *eval_batches)
+                    with phase_timer.phase(round_idx, "eval") as _ph:
+                        metrics_dev = evaluate(new_global, *eval_batches)
+                        _ph.fence(metrics_dev)
+                if recompile is not None:
+                    # Compiles are synchronous with trace/lower, so events
+                    # pending here came from this round's dispatches
+                    # (under pipelining, the deferred finalize of round
+                    # r-1 runs after this and must not absorb them).
+                    recompile.attribute(round_idx)
                 entry = {
                     "round_idx": round_idx,
                     "new_global": new_global,
@@ -920,6 +985,13 @@ def run_simulation(
         # Robustness telemetry (quorum policy, docs/ROBUSTNESS.md): always
         # present so downstream consumers (bench.py) need no key checks.
         "rounds_rejected": telemetry["rounds_rejected"],
+        # Run telemetry (docs/OBSERVABILITY.md): post-warmup XLA compile
+        # count — 0 on a shape-stable run; None when telemetry is off.
+        "telemetry_level": tel_level,
+        "post_warmup_compiles": (
+            post_warmup_compiles["count"]
+            if post_warmup_compiles is not None else None
+        ),
         "mean_survivor_count": (
             float(np.mean(telemetry["survivor_counts"]))
             if telemetry["survivor_counts"] else None

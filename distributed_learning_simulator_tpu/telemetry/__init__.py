@@ -1,0 +1,53 @@
+"""Run telemetry: per-phase timing, recompile tracking, HBM watermarks.
+
+The reference simulator has zero performance instrumentation (SURVEY §5),
+and before this subsystem the reproduction measured cost as one opaque
+``round_seconds`` wall-clock number. This package is the observability
+layer every execution path (vmap simulator, threaded oracle, multihost
+engine) reports through:
+
+* :mod:`.phases` — per-round phase timers (client step / aggregate /
+  eval / host-sync / post-round) around the existing ``annotate()``
+  regions, with ``block_until_ready`` fencing only when
+  ``telemetry_level='detailed'`` asks for it, so the default program is
+  untouched.
+* :mod:`.recompile` — an XLA recompilation counter hooked on
+  ``jax.monitoring`` compile events (names recovered from the
+  ``jax_log_compiles`` log stream): any compile after the warmup round
+  flags a shape-instability bug with the offending function name.
+* :mod:`.memory` — the ONE ``memory_stats()`` probe (HBM watermark +
+  capacity), replacing the ad-hoc call sites that used to be duplicated
+  in simulator.py and scripts/measure_gtg_scale.py.
+
+Records land in ``metrics.jsonl`` through the schema-versioned builder in
+``utils/reporting.py``; ``scripts/report_run.py`` renders an artifacts
+dir offline. Levels, schema, and interpretation: docs/OBSERVABILITY.md.
+"""
+
+from distributed_learning_simulator_tpu.config import TELEMETRY_LEVELS
+from distributed_learning_simulator_tpu.telemetry.memory import (
+    device_memory_stats,
+    hbm_limit_bytes,
+    peak_hbm_bytes,
+)
+from distributed_learning_simulator_tpu.telemetry.phases import (
+    NullPhaseTimer,
+    PhaseTimer,
+    make_phase_timer,
+)
+from distributed_learning_simulator_tpu.telemetry.recompile import (
+    RecompileMonitor,
+    log_round_compiles,
+)
+
+__all__ = [
+    "TELEMETRY_LEVELS",
+    "NullPhaseTimer",
+    "PhaseTimer",
+    "RecompileMonitor",
+    "device_memory_stats",
+    "hbm_limit_bytes",
+    "log_round_compiles",
+    "make_phase_timer",
+    "peak_hbm_bytes",
+]

@@ -2,7 +2,8 @@
 
 Parity with the reference's ``get_logger`` / ``set_file_handler`` surface
 (reference simulator.py:7,38-46): one framework-global logger, with an optional
-file sink at ``log/<algorithm>/<dataset>/<model>/<timestamp>.log``.
+file sink at ``log/<algorithm>/<dataset>/<model>/<run-id>.log`` (run id =
+seconds_microseconds_pid, unique per run even for same-second starts).
 """
 
 from __future__ import annotations
@@ -28,6 +29,26 @@ def get_logger() -> logging.Logger:
     return logger
 
 
+def _claim_run_path(log_dir: str, stamp: str) -> str:
+    """Atomically claim a unique ``<stamp>[_N].log`` in ``log_dir``.
+
+    ``O_CREAT|O_EXCL`` makes the claim race-free across processes: two
+    runs that resolve the same stamp (coarse clocks, forked pids) get
+    distinct files instead of interleaving one — the collision that used
+    to overwrite logs and interleave metrics.jsonl when two runs started
+    within the same second.
+    """
+    path = os.path.join(log_dir, f"{stamp}.log")
+    n = 0
+    while True:
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            return path
+        except FileExistsError:
+            n += 1
+            path = os.path.join(log_dir, f"{stamp}_{n}.log")
+
+
 def set_file_handler(
     log_root: str,
     algorithm: str,
@@ -38,12 +59,17 @@ def set_file_handler(
     """Attach a per-run file sink; returns the log file path.
 
     Layout parity with reference simulator.py:38-46:
-    ``<log_root>/<algorithm>/<dataset>/<model>/<timestamp>.log``.
+    ``<log_root>/<algorithm>/<dataset>/<model>/<run-id>.log`` — but the
+    run id is ``<unix-seconds>_<microseconds>_<pid>`` (plus a counter
+    suffix on collision) rather than the reference's bare ``int(ts)``,
+    which made two runs starting within the same second overwrite each
+    other's log and interleave their ``metrics.jsonl``.
     """
     ts = timestamp if timestamp is not None else time.time()
     log_dir = os.path.join(log_root, algorithm, dataset, model)
     os.makedirs(log_dir, exist_ok=True)
-    path = os.path.join(log_dir, f"{int(ts)}.log")
+    stamp = f"{int(ts)}_{int((ts % 1) * 1e6):06d}_{os.getpid()}"
+    path = _claim_run_path(log_dir, stamp)
     logger = get_logger()
     # One file sink per run: detach the previous run's handler (else a
     # long-lived process fans every later run's lines into all earlier

@@ -1,11 +1,85 @@
-"""Shared construction of tracked benchmark records.
+"""Shared construction of tracked benchmark + metrics records.
 
-One definition of each tracked metric's shape, so the driver-facing
-emitters (bench.py sub-objects, scripts/* JSON lines) cannot drift into
-reporting incomparable numbers for the same cost unit.
+One definition of each tracked record's shape — the per-round
+metrics.jsonl line (schema-versioned, telemetry-aware), the bench
+provenance stamp, and the converged-GTG cost record — so the emitters
+(simulator.py, execution/threaded.py, bench.py sub-objects, scripts/*
+JSON lines) cannot drift into reporting incomparable numbers for the
+same cost unit.
 """
 
 from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+# metrics.jsonl layout version. v1 (implicit — no version field) is the
+# pre-telemetry record: round/test_accuracy/test_loss/… only. v2 adds
+# ``schema_version`` and the ``telemetry`` sub-object (phase_seconds,
+# compiles, peak_hbm_bytes; docs/OBSERVABILITY.md). telemetry_level='off'
+# keeps emitting v1 byte-for-byte so longitudinal tooling never sees a
+# layout change it didn't opt into.
+METRICS_SCHEMA_VERSION = 2
+
+# bench.py output version. v1 (implicit) had no provenance; v2 stamps
+# ``schema_version`` + ``config_hash`` so scripts/compare_bench.py can
+# refuse to diff incomparable runs.
+BENCH_SCHEMA_VERSION = 2
+
+# Config fields that do NOT define the measured program: two runs
+# differing only in these are still comparable cost points. Everything
+# else (model, population, chunking, dtypes, failure knobs, ...) lands in
+# the hash. ``round`` is excluded because per-round medians are
+# comparable across run lengths (bench records its rounds separately).
+# ``telemetry_level`` is deliberately NOT excluded: 'detailed' fences
+# every phase and defeats round pipelining, so its wall-clock is not a
+# comparable cost point against an unfenced run.
+_NON_PROGRAM_FIELDS = (
+    "round",
+    "log_root",
+    "log_level",
+    "compilation_cache_dir",
+    "profile_dir",
+    "profile_from_round",
+    "checkpoint_dir",
+    "checkpoint_every",
+    "checkpoint_keep_last",
+    "resume",
+    "data_dir",
+)
+
+
+def build_round_record(base: dict, telemetry: dict | None = None) -> dict:
+    """The ONE per-round metrics.jsonl record builder (vmap simulator and
+    threaded oracle both write through this).
+
+    ``telemetry=None`` (``telemetry_level='off'``) returns ``base``
+    unchanged — the legacy v1 layout, byte-identical to pre-telemetry
+    builds. A telemetry dict upgrades the record to v2: ``schema_version``
+    plus the ``telemetry`` sub-object.
+    """
+    if telemetry is None:
+        return base
+    record = dict(base)
+    record["schema_version"] = METRICS_SCHEMA_VERSION
+    record["telemetry"] = telemetry
+    return record
+
+
+def config_hash(config) -> str:
+    """Short stable hash of the program-defining config fields.
+
+    Stamped into bench output (with :data:`BENCH_SCHEMA_VERSION`) so
+    compare_bench.py can refuse to diff runs whose knobs make their
+    numbers incomparable. JSON-serialized with sorted keys (repr fallback
+    for exotic values) so dict-field ordering can't move the hash.
+    """
+    d = dataclasses.asdict(config)
+    for k in _NON_PROGRAM_FIELDS:
+        d.pop(k, None)
+    blob = json.dumps(d, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
 def gtg_round_record(history, **extra):

@@ -91,6 +91,35 @@ def parse_device_trace(trace_dir: str) -> dict:
     }
 
 
+def top_device_ops(trace_dir: str, k: int = 10) -> list[dict]:
+    """Top-``k`` device ops by bytes accessed (time as tiebreaker),
+    aggregated by op name over :func:`iter_device_ops`.
+
+    The offline run reporter (scripts/report_run.py) renders this as the
+    "where did the bytes go" table; same selection rule as the bench
+    proxy, so an op that moves the proxy total is findable by name here.
+    """
+    agg: dict[str, dict] = {}
+    for ev in iter_device_ops(trace_dir):
+        args = ev.get("args") or {}
+        name = ev.get("name", "<unnamed>")
+        entry = agg.setdefault(
+            name, {"name": name, "bytes_gb": 0.0, "device_ms": 0.0,
+                   "count": 0}
+        )
+        entry["bytes_gb"] += float(args.get("raw_bytes_accessed", 0) or 0)
+        entry["device_ms"] += float(ev.get("dur", 0.0)) / 1e3
+        entry["count"] += 1
+    for entry in agg.values():
+        entry["bytes_gb"] = entry["bytes_gb"] / 2**30
+    ranked = sorted(
+        agg.values(),
+        key=lambda e: (e["bytes_gb"], e["device_ms"]),
+        reverse=True,
+    )
+    return ranked[:k]
+
+
 def annotate(name: str):
     """Named region visible in TPU traces (wraps jax.profiler annotations)."""
     return jax.profiler.TraceAnnotation(name)

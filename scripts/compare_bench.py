@@ -1,0 +1,175 @@
+"""Diff two bench JSON records with a relative-threshold regression gate.
+
+The BENCH_r*.json trajectory used to be eyeball-only: a reviewer had to
+hand-diff nested JSON to notice a lost fusion or a slower flagship leg.
+This tool makes it a gate:
+
+    python scripts/compare_bench.py OLD.json NEW.json [--threshold 0.05]
+        [--force] [--json]
+
+* Exit 0 — no tracked metric regressed beyond the threshold.
+* Exit 1 — regression(s): any tracked metric moved in its BAD direction
+  by more than ``--threshold`` (relative). Each is printed with both
+  values and the relative change.
+* Exit 2 — the runs are not comparable (``config_hash`` mismatch — the
+  program-defining knobs differ — or bench ``schema_version`` mismatch)
+  and ``--force`` was not given. Records predating the provenance stamp
+  (no ``schema_version``/``config_hash``) compare value-by-value with a
+  warning; the gate cannot prove comparability for them.
+
+Tracked metrics (missing on either side -> skipped, listed as such):
+headline/flagship rates (higher is better), converged-GTG round seconds
+(lower), the deterministic traced-bytes proxies (lower — these are
+byte-exact program properties, so ANY growth beyond the threshold is a
+real program change), rejected-round and survivor robustness counters.
+
+Deliberately imports nothing heavy (no jax): usable as a CI gate and
+fast enough to self-test in tier-1 (tests/test_compare_bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# (dotted path, direction, description). Direction is the GOOD direction;
+# a relative move against it beyond the threshold is a regression.
+TRACKED = [
+    ("value", "higher", "headline median clients*rounds/s"),
+    ("mean_rate", "higher", "headline mean clients*rounds/s"),
+    ("flagship.value", "higher", "flagship median clients*rounds/s"),
+    ("gtg.value", "lower", "converged-GTG round seconds"),
+    ("proxy.traced_bytes_gb", "lower", "cnn traced bytes proxy (GB)"),
+    ("proxy.traced_op_count", "lower", "cnn traced op count"),
+    ("proxy_flagship.traced_bytes_gb", "lower",
+     "flagship traced bytes proxy (GB)"),
+    ("proxy_flagship.traced_op_count", "lower", "flagship traced op count"),
+    ("robustness.rounds_rejected", "lower", "quorum-rejected rounds"),
+    ("robustness.mean_survivor_count", "higher", "mean survivor count"),
+]
+
+
+def get_path(record: dict, dotted: str):
+    """Resolve a dotted path; None when any hop is missing/non-numeric."""
+    node = record
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node if isinstance(node, (int, float)) and not isinstance(
+        node, bool
+    ) else None
+
+
+def check_comparable(old: dict, new: dict) -> str | None:
+    """Reason the two records must NOT be gate-compared, or None if OK.
+
+    Refusal needs the stamp on BOTH sides: pre-provenance records (bench
+    schema v1, no stamp) can't prove incomparability, so they pass with
+    the caveat printed by main().
+    """
+    o_v, n_v = old.get("schema_version"), new.get("schema_version")
+    if o_v is not None and n_v is not None and o_v != n_v:
+        return f"bench schema_version differs: {o_v} vs {n_v}"
+    o_h, n_h = old.get("config_hash"), new.get("config_hash")
+    if o_h is not None and n_h is not None and o_h != n_h:
+        return (
+            f"config_hash differs: {o_h} vs {n_h} — the runs measured "
+            "different programs (model/population/chunk/dtype/failure "
+            "knobs); re-run one side or pass --force"
+        )
+    return None
+
+
+def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
+    """Pure comparison: returns ``{"regressions", "improvements",
+    "unchanged", "skipped"}`` lists of per-metric dicts."""
+    out = {"regressions": [], "improvements": [], "unchanged": [],
+           "skipped": []}
+    for dotted, direction, desc in TRACKED:
+        o, n = get_path(old, dotted), get_path(new, dotted)
+        if o is None or n is None:
+            out["skipped"].append({"metric": dotted, "description": desc})
+            continue
+        if o == 0:
+            # Relative change undefined; only an absolute move in the bad
+            # direction counts (covers counters like rounds_rejected=0).
+            bad = (n > 0) if direction == "lower" else (n < 0)
+            rel = None
+        else:
+            rel = (n - o) / abs(o)
+            worse = -rel if direction == "higher" else rel
+            bad = worse > threshold
+        entry = {
+            "metric": dotted, "description": desc, "old": o, "new": n,
+            "relative_change": rel, "direction": direction,
+        }
+        if bad:
+            out["regressions"].append(entry)
+        elif rel is not None and abs(rel) > threshold:
+            out["improvements"].append(entry)
+        else:
+            out["unchanged"].append(entry)
+    return out
+
+
+def _fmt(entry: dict) -> str:
+    rel = entry["relative_change"]
+    rel_s = f"{rel:+.1%}" if rel is not None else "n/a"
+    return (
+        f"  {entry['metric']:<34} {entry['old']:>12g} -> "
+        f"{entry['new']:>12g}  ({rel_s}, {entry['direction']} is better) "
+        f"— {entry['description']}"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Regression gate over two bench.py JSON records"
+    )
+    ap.add_argument("old", help="baseline bench JSON file")
+    ap.add_argument("new", help="candidate bench JSON file")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression tolerance (default 0.05)")
+    ap.add_argument("--force", action="store_true",
+                    help="compare even when provenance says incomparable")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable comparison as JSON")
+    args = ap.parse_args(argv)
+
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+
+    reason = check_comparable(old, new)
+    if reason and not args.force:
+        print(f"REFUSED: {reason}", file=sys.stderr)
+        return 2
+    if old.get("config_hash") is None or new.get("config_hash") is None:
+        print(
+            "note: at least one record predates the provenance stamp "
+            "(bench schema v1); comparability is not verifiable",
+            file=sys.stderr,
+        )
+
+    result = compare_records(old, new, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        for title, key in (("REGRESSIONS", "regressions"),
+                           ("improvements", "improvements"),
+                           ("within threshold", "unchanged")):
+            if result[key]:
+                print(f"{title}:")
+                for entry in result[key]:
+                    print(_fmt(entry))
+        if result["skipped"]:
+            print("skipped (absent on one side): "
+                  + ", ".join(e["metric"] for e in result["skipped"]))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,8 +31,6 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax
-
 
 def main():
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
@@ -67,16 +65,16 @@ def main():
             f"converged={h.get('gtg_converged')}"
         )
     print(f"total wall: {wall:.1f}s for {rounds} rounds")
-    peak = None
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        peak = stats.get("peak_bytes_in_use")
-        if peak:
-            print(f"peak HBM: {peak / 2**30:.2f} GiB")
-        else:
-            print(f"memory_stats keys: {sorted(stats)}")
-    except Exception as e:  # plugin may not expose memory stats
-        print(f"memory_stats unavailable: {e}")
+    # The shared telemetry probe (telemetry/memory.py): graceful None on
+    # backends without memory stats, same helper the simulator's per-round
+    # watermark and budget model use.
+    from distributed_learning_simulator_tpu.telemetry import peak_hbm_bytes
+
+    peak = peak_hbm_bytes()
+    if peak:
+        print(f"peak HBM: {peak / 2**30:.2f} GiB")
+    else:
+        print("memory_stats unavailable on this backend")
 
     # Tracked metric (ISSUE 1): converged-GTG round wall-clock — the same
     # record shape bench.py's ``gtg`` sub-object emits (one shared

@@ -1,0 +1,268 @@
+"""Offline run reporter: render a run's artifacts dir into a summary.
+
+    python scripts/report_run.py <artifacts_dir | metrics.jsonl>
+        [--trace DIR] [--json OUT.json] [--top K]
+
+Input is the per-run artifacts directory the simulator writes
+(``log/<algo>/<dataset>/<model>/<run-id>_artifacts`` containing
+``metrics.jsonl``) or a ``metrics.jsonl`` path directly. Renders a
+terminal summary — accuracy curve, per-round phase-time breakdown,
+compile events, rejected rounds, peak HBM — and optionally writes the
+same content as machine-readable JSON (``--json``). ``--trace`` points
+at a ``jax.profiler`` trace directory (``config.profile_dir``) and adds
+the deterministic device-op totals plus a top-ops-by-bytes table (same
+selection rule as bench.py's regression proxy: utils/tracing.py).
+
+Reads both metrics schemas: v1 (pre-telemetry; accuracy/timing only) and
+v2 (``telemetry`` sub-object — see docs/OBSERVABILITY.md). The only
+heavy import (jax, via utils.tracing) is deferred behind ``--trace``, so
+metrics-only reporting is instant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float]) -> str:
+    """Unicode sparkline; constant series render flat, not empty."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[int((v - lo) / span * (len(_SPARK) - 1))] for v in values
+    )
+
+
+def load_metrics(path: str) -> list[dict]:
+    """Read metrics.jsonl records from a file or an artifacts dir."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no metrics.jsonl at {path!r} — pass a run's artifacts dir "
+            "(log/<algo>/<dataset>/<model>/<run-id>_artifacts) or the "
+            "file itself"
+        )
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def summarize_run(records: list[dict], trace_stats: dict | None = None,
+                  top_ops: list[dict] | None = None) -> dict:
+    """Aggregate metrics records into the machine-readable summary the
+    terminal renderer and ``--json`` output share."""
+    if not records:
+        raise ValueError("metrics.jsonl holds no records")
+    accs = [r.get("test_accuracy") for r in records]
+    secs = [r["round_seconds"] for r in records if "round_seconds" in r]
+    best_idx = max(
+        range(len(records)),
+        key=lambda i: -1.0 if accs[i] is None else accs[i],
+    )
+    summary: dict = {
+        "rounds": len(records),
+        "first_round": records[0].get("round"),
+        "last_round": records[-1].get("round"),
+        "schema_versions": sorted(
+            {r.get("schema_version", 1) for r in records}
+        ),
+        "final_accuracy": accs[-1],
+        "best_accuracy": accs[best_idx],
+        "best_round": records[best_idx].get("round"),
+        "accuracy_curve": accs,
+        "round_seconds": {
+            "total": sum(secs),
+            "mean": statistics.mean(secs) if secs else None,
+            "median": statistics.median(secs) if secs else None,
+            "max": max(secs) if secs else None,
+        },
+    }
+    rejected = [
+        r.get("round") for r in records if r.get("round_rejected")
+    ]
+    summary["rejected_rounds"] = {"count": len(rejected), "rounds": rejected}
+
+    # --- telemetry sub-objects (schema v2) ----------------------------------
+    tels = [(r.get("round"), r["telemetry"]) for r in records
+            if isinstance(r.get("telemetry"), dict)]
+    if tels:
+        phase_tot: dict[str, float] = {}
+        per_round_phases = []
+        for rnd, tel in tels:
+            phases = tel.get("phase_seconds") or {}
+            per_round_phases.append({"round": rnd, **phases})
+            for name, secs_ in phases.items():
+                phase_tot[name] = phase_tot.get(name, 0.0) + secs_
+        grand = sum(phase_tot.values()) or 1.0
+        summary["phases"] = {
+            name: {
+                "total_s": round(total, 3),
+                "mean_s": round(total / len(tels), 4),
+                "share": round(total / grand, 3),
+            }
+            for name, total in sorted(
+                phase_tot.items(), key=lambda kv: -kv[1]
+            )
+        }
+        summary["phase_seconds_per_round"] = per_round_phases
+
+        # Only when the records actually carry per-round compile counts
+        # (the threaded oracle's records don't — its compile count is
+        # run-scoped in the result dict): a missing key must not render
+        # as a fabricated "0 compiles, shape-stable" verdict.
+        if any("compiles" in tel for _, tel in tels):
+            warmup_round = records[0].get("round")
+            compile_rounds = [
+                {"round": rnd, "compiles": tel.get("compiles", 0),
+                 "compiled": tel.get("compiled", [])}
+                for rnd, tel in tels if tel.get("compiles")
+            ]
+            summary["compiles"] = {
+                "total": sum(c["compiles"] for c in compile_rounds),
+                "warmup": sum(c["compiles"] for c in compile_rounds
+                              if c["round"] == warmup_round),
+                "post_warmup": sum(c["compiles"] for c in compile_rounds
+                                   if c["round"] != warmup_round),
+                "rounds": compile_rounds,
+            }
+        peaks = [tel["peak_hbm_bytes"] for _, tel in tels
+                 if tel.get("peak_hbm_bytes")]
+        summary["peak_hbm_bytes"] = max(peaks) if peaks else None
+
+    if trace_stats is not None:
+        summary["trace"] = trace_stats
+    if top_ops is not None:
+        summary["top_device_ops"] = top_ops
+    return summary
+
+
+def render_summary(summary: dict) -> list[str]:
+    """Terminal rendering of :func:`summarize_run`'s output."""
+    lines = []
+    v = "/".join(str(s) for s in summary["schema_versions"])
+    lines.append(
+        f"run: rounds {summary['first_round']}..{summary['last_round']} "
+        f"({summary['rounds']} recorded, metrics schema v{v})"
+    )
+    accs = [a for a in summary["accuracy_curve"] if a is not None]
+    if accs:
+        lines.append(
+            f"accuracy: final {summary['final_accuracy']:.4f}, "
+            f"best {summary['best_accuracy']:.4f} "
+            f"@ round {summary['best_round']}"
+        )
+        lines.append(f"  curve: {sparkline(accs)}")
+    rs = summary["round_seconds"]
+    if rs["mean"] is not None:
+        lines.append(
+            f"round time: total {rs['total']:.2f}s, mean {rs['mean']:.3f}s, "
+            f"median {rs['median']:.3f}s, max {rs['max']:.3f}s"
+        )
+    rej = summary["rejected_rounds"]
+    if rej["count"]:
+        lines.append(
+            f"rejected rounds (quorum): {rej['count']} — {rej['rounds']}"
+        )
+    else:
+        lines.append("rejected rounds (quorum): 0")
+
+    if "phases" in summary:
+        lines.append("phase breakdown (per-round mean, share of phased time):")
+        for name, st in summary["phases"].items():
+            bar = "#" * max(1, int(st["share"] * 40))
+            lines.append(
+                f"  {name:<12} {st['mean_s']:>9.4f}s  "
+                f"{st['share']:>6.1%}  {bar}"
+            )
+    if "compiles" in summary:
+        c = summary["compiles"]
+        lines.append(
+            f"XLA compiles: {c['total']} total "
+            f"({c['warmup']} warmup, {c['post_warmup']} post-warmup)"
+        )
+        for cr in c["rounds"]:
+            if cr["round"] != summary["first_round"]:
+                names = ", ".join(cr["compiled"]) or "<unknown>"
+                lines.append(
+                    f"  !! round {cr['round']}: {cr['compiles']} "
+                    f"recompile(s) after warmup — {names}"
+                )
+        if c["post_warmup"] == 0:
+            lines.append("  post-warmup recompiles: none (shape-stable run)")
+    peak = summary.get("peak_hbm_bytes")
+    if peak:
+        lines.append(f"peak HBM: {peak / 2**30:.2f} GiB")
+    elif "phases" in summary:
+        lines.append("peak HBM: unavailable on this backend")
+
+    if "trace" in summary:
+        t = summary["trace"]
+        lines.append(
+            f"device trace: {t['device_ms']:.1f} ms device time, "
+            f"{t['bytes_gb']:.3f} GB accessed, {t['op_count']} ops"
+        )
+    for op in summary.get("top_device_ops", []):
+        lines.append(
+            f"  {op['bytes_gb']:>8.3f} GB  {op['device_ms']:>8.2f} ms  "
+            f"x{op['count']:<5} {op['name']}"
+        )
+    return lines
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a run's artifacts dir into a telemetry summary"
+    )
+    ap.add_argument("artifacts",
+                    help="run artifacts dir or metrics.jsonl path")
+    ap.add_argument("--trace", default=None,
+                    help="jax.profiler trace dir (config.profile_dir)")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary as JSON to this path")
+    ap.add_argument("--top", type=int, default=10,
+                    help="top-K device ops from --trace (default 10)")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_metrics(args.artifacts)
+        trace_stats = top_ops = None
+        if args.trace:
+            # Deferred: utils.tracing imports jax.
+            from distributed_learning_simulator_tpu.utils.tracing import (
+                parse_device_trace,
+                top_device_ops,
+            )
+
+            trace_stats = parse_device_trace(args.trace)
+            top_ops = top_device_ops(args.trace, k=args.top)
+        summary = summarize_run(records, trace_stats=trace_stats,
+                                top_ops=top_ops)
+    except (FileNotFoundError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for line in render_summary(summary):
+        print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+        print(f"summary JSON: {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

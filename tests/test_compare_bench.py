@@ -1,0 +1,132 @@
+"""scripts/compare_bench.py: the bench-JSON regression gate's self-test.
+
+Pure-Python (the script deliberately imports no jax), so this is the
+fast tier-1 wiring the satellite task asks for: the gate's direction
+semantics, the provenance refusal, and the CLI exit codes.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "scripts", "compare_bench.py"
+)
+
+
+@pytest.fixture(scope="module")
+def cb():
+    spec = importlib.util.spec_from_file_location("compare_bench", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _record(value=9000.0, gtg=50.0, bytes_gb=100.0, **extra):
+    return {
+        "schema_version": 2,
+        "config_hash": "abcdef123456",
+        "metric": "simulated_clients_x_rounds_per_sec",
+        "value": value,
+        "mean_rate": value * 0.98,
+        "flagship.unused": 1,
+        "gtg": {"value": gtg},
+        "proxy": {"traced_bytes_gb": bytes_gb, "traced_op_count": 500},
+        "robustness": {"rounds_rejected": 0, "mean_survivor_count": 9.0},
+        **extra,
+    }
+
+
+def test_no_regression_within_threshold(cb):
+    old, new = _record(), _record(value=9100.0, gtg=49.0)
+    assert cb.check_comparable(old, new) is None
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert result["regressions"] == []
+    assert any(e["metric"] == "value" for e in result["unchanged"])
+
+
+def test_detects_regressions_in_both_directions(cb):
+    """higher-is-better dropping and lower-is-better growing both gate."""
+    old = _record(value=9000.0, gtg=50.0, bytes_gb=100.0)
+    new = _record(value=8000.0, gtg=60.0, bytes_gb=120.0)  # all worse >5%
+    result = cb.compare_records(old, new, threshold=0.05)
+    flagged = {e["metric"] for e in result["regressions"]}
+    assert {"value", "gtg.value", "proxy.traced_bytes_gb"} <= flagged
+    # The same moves in the GOOD direction are improvements, not flags.
+    result_rev = cb.compare_records(new, old, threshold=0.05)
+    assert result_rev["regressions"] == []
+    assert {e["metric"] for e in result_rev["improvements"]} >= {
+        "value", "gtg.value", "proxy.traced_bytes_gb",
+    }
+
+
+def test_zero_baseline_counter_gates_on_any_increase(cb):
+    """rounds_rejected 0 -> 2 must gate even though relative change is
+    undefined at a zero baseline."""
+    old, new = _record(), _record()
+    new["robustness"]["rounds_rejected"] = 2
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert any(
+        e["metric"] == "robustness.rounds_rejected"
+        for e in result["regressions"]
+    )
+
+
+def test_missing_metrics_are_skipped_not_flagged(cb):
+    old, new = _record(), _record()
+    del new["gtg"]
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert any(e["metric"] == "gtg.value" for e in result["skipped"])
+    assert not any(
+        e["metric"] == "gtg.value" for e in result["regressions"]
+    )
+
+
+def test_provenance_refusal(cb):
+    old, new = _record(), _record()
+    new["config_hash"] = "fedcba654321"
+    assert "config_hash" in cb.check_comparable(old, new)
+    new["config_hash"] = old["config_hash"]
+    new["schema_version"] = 3
+    assert "schema_version" in cb.check_comparable(old, new)
+    # Records predating the stamp can't prove incomparability -> allowed.
+    legacy = {"metric": "simulated_clients_x_rounds_per_sec", "value": 9000}
+    assert cb.check_comparable(legacy, _record()) is None
+
+
+def test_cli_exit_codes(cb, tmp_path):
+    """0 = clean, 1 = regression, 2 = provenance refusal (--force
+    overrides)."""
+    old, good, bad = _record(), _record(value=9050.0), _record(value=5000.0)
+    foreign = _record(value=9050.0)
+    foreign["config_hash"] = "fedcba654321"
+    paths = {}
+    for name, rec in [("old", old), ("good", good), ("bad", bad),
+                      ("foreign", foreign)]:
+        p = tmp_path / f"{name}.json"
+        p.write_text(json.dumps(rec))
+        paths[name] = str(p)
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, _SCRIPT, *args],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    assert run(paths["old"], paths["good"]).returncode == 0
+    proc = run(paths["old"], paths["bad"])
+    assert proc.returncode == 1
+    assert "REGRESSIONS" in proc.stdout and "value" in proc.stdout
+    proc = run(paths["old"], paths["foreign"])
+    assert proc.returncode == 2
+    assert "config_hash" in proc.stderr
+    # --force compares anyway; identical-enough values -> clean exit.
+    assert run(paths["old"], paths["foreign"], "--force").returncode == 0
+    # --json emits the machine-readable comparison.
+    proc = run(paths["old"], paths["bad"], "--json")
+    assert proc.returncode == 1
+    assert json.loads(proc.stdout)["regressions"]

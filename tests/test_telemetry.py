@@ -1,0 +1,333 @@
+"""telemetry/ subsystem: recompile counter, phase timing, records, report.
+
+Acceptance pins (ISSUE 3): a deliberately shape-unstable run trips the
+recompilation counter with the offending function name surfaced in the
+log; a stable run reports 0 post-warmup compiles; report_run renders a
+real run's artifacts dir; telemetry_level='off' leaves metrics.jsonl
+records in the legacy (v1) layout.
+"""
+
+import dataclasses
+import glob
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_learning_simulator_tpu.telemetry import (
+    NullPhaseTimer,
+    PhaseTimer,
+    RecompileMonitor,
+    device_memory_stats,
+    hbm_limit_bytes,
+    log_round_compiles,
+    make_phase_timer,
+    peak_hbm_bytes,
+)
+from distributed_learning_simulator_tpu.utils.reporting import (
+    METRICS_SCHEMA_VERSION,
+    build_round_record,
+    config_hash,
+)
+
+# ---------------------------------------------------------------- recompile
+
+
+def test_recompile_monitor_shape_unstable_run():
+    """A deliberately shape-unstable jitted function trips the counter —
+    with its name — while the cached-shape call counts zero."""
+    mon = RecompileMonitor()
+    with mon:
+        @jax.jit
+        def wobbly_step(x):
+            return x * 2.0
+
+        wobbly_step(jnp.ones(8)).block_until_ready()
+        mon.attribute(0)  # warmup: first shape compiles
+        wobbly_step(jnp.ones(8)).block_until_ready()
+        mon.attribute(1)  # cached: no compile
+        wobbly_step(jnp.ones(9)).block_until_ready()  # NEW shape: recompile
+        mon.attribute(2)
+    warmup, stable, unstable = mon.take(0), mon.take(1), mon.take(2)
+    assert any("wobbly_step" in name for name, _ in warmup)
+    assert stable == []
+    assert any("wobbly_step" in name for name, _ in unstable)
+    # take() pops: a second read is empty.
+    assert mon.take(2) == []
+
+
+def test_recompile_monitor_restores_global_state():
+    """start/stop must restore jax_log_compiles and the compile loggers'
+    propagation — the monitor owns process-global state only while
+    active."""
+    dispatch = logging.getLogger("jax._src.dispatch")
+    before_flag = bool(jax.config.jax_log_compiles)
+    before_prop = dispatch.propagate
+    before_handlers = list(dispatch.handlers)
+    mon = RecompileMonitor().start()
+    assert bool(jax.config.jax_log_compiles) is True
+    assert dispatch.propagate is False
+    mon.stop()
+    assert bool(jax.config.jax_log_compiles) == before_flag
+    assert dispatch.propagate == before_prop
+    assert dispatch.handlers == before_handlers
+    mon.stop()  # idempotent
+
+
+def test_log_round_compiles_surfaces_offender_name():
+    """Post-warmup compiles WARN with the offending function name; warmup
+    compiles stay at INFO."""
+    logger = logging.getLogger("test_telemetry_compiles")
+    logger.propagate = True
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, r):
+            records.append(r)
+
+    h = _Cap()
+    logger.addHandler(h)
+    logger.setLevel(logging.INFO)
+    try:
+        n = log_round_compiles(
+            logger, 7, [("round_fn", 12.5)], warmup=False
+        )
+        assert n == 1
+        warn = [r for r in records if r.levelno == logging.WARNING]
+        assert len(warn) == 1
+        msg = warn[0].getMessage()
+        assert "round_fn" in msg and "round 7" in msg
+        assert "AFTER warmup" in msg
+        records.clear()
+        log_round_compiles(logger, 0, [("round_fn", 12.5)], warmup=True)
+        assert all(r.levelno == logging.INFO for r in records)
+        assert log_round_compiles(logger, 3, [], warmup=False) == 0
+    finally:
+        logger.removeHandler(h)
+
+
+# ------------------------------------------------------------- phase timer
+
+
+def test_phase_timer_accumulates_and_pops():
+    t = PhaseTimer(fence=False)
+    with t.phase(0, "client_step"):
+        pass
+    with t.phase(0, "client_step"):  # same phase accumulates
+        pass
+    with t.phase(0, "eval"):
+        pass
+    with t.phase(1, "client_step"):
+        pass
+    r0 = t.take(0)
+    assert set(r0) == {"client_step", "eval"}
+    assert all(v >= 0.0 for v in r0.values())
+    assert t.take(0) == {}  # popped
+    assert set(t.take(1)) == {"client_step"}
+
+
+def test_phase_timer_fences_on_device_value():
+    """With fence=True the phase blocks on the parked output before the
+    clock stops (block_until_ready on the fenced tree must not raise on
+    nested containers)."""
+    t = PhaseTimer(fence=True)
+    with t.phase(0, "client_step") as ph:
+        out = jax.jit(lambda x: x * 3.0)(jnp.ones((64, 64)))
+        ph.fence((out, {"aux": out}))
+    assert t.take(0)["client_step"] > 0.0
+
+
+def test_make_phase_timer_levels():
+    assert isinstance(make_phase_timer("off"), NullPhaseTimer)
+    assert not make_phase_timer("off").enabled
+    basic = make_phase_timer("basic")
+    assert isinstance(basic, PhaseTimer) and not basic._fence
+    assert make_phase_timer("detailed")._fence
+    null = make_phase_timer("off")
+    with null.phase(0, "x") as ph:
+        ph.fence(jnp.ones(2))
+    assert null.take(0) is None
+
+
+# ------------------------------------------------------------ memory probe
+
+
+def test_memory_probe_graceful_on_cpu():
+    """CPU reports no memory stats: every helper must return None, never
+    raise (the graceful-None contract the watermark/budget callers
+    rely on)."""
+    stats = device_memory_stats()
+    if stats is None:  # CPU backend (the CI case)
+        assert peak_hbm_bytes() is None
+        assert hbm_limit_bytes() is None
+    else:  # a real accelerator: values are positive ints when present
+        for v in (peak_hbm_bytes(), hbm_limit_bytes()):
+            assert v is None or (isinstance(v, int) and v > 0)
+
+
+# ----------------------------------------------------------- record builder
+
+
+def test_build_round_record_off_is_identity():
+    """telemetry=None returns the base record UNTOUCHED — the
+    byte-identical-at-'off' guarantee reduces to this plus the
+    integration test below."""
+    base = {"round": 3, "test_accuracy": 0.5, "round_seconds": 1.0}
+    out = build_round_record(base, None)
+    assert out is base  # not even a copy: nothing can have changed
+    assert json.dumps(out) == json.dumps(base)
+
+
+def test_build_round_record_v2_layout():
+    base = {"round": 3, "test_accuracy": 0.5}
+    tel = {"phase_seconds": {"eval": 0.1}, "compiles": 0}
+    out = build_round_record(base, tel)
+    assert out is not base and "telemetry" not in base
+    assert out["schema_version"] == METRICS_SCHEMA_VERSION
+    assert out["telemetry"] == tel
+    assert out["round"] == 3
+
+
+def test_config_hash_tracks_program_knobs_only(tiny_config):
+    h = config_hash(tiny_config)
+    assert len(h) == 12
+    same = dataclasses.replace(
+        tiny_config, round=99, log_level="DEBUG",
+        checkpoint_dir="/tmp/x", profile_dir="/tmp/y",
+    )
+    assert config_hash(same) == h
+    assert config_hash(
+        dataclasses.replace(tiny_config, model_name="lenet5")
+    ) != h
+    assert config_hash(
+        dataclasses.replace(tiny_config, failure_mode="dropout")
+    ) != h
+    # 'detailed' fences every phase (not a comparable cost point), so
+    # telemetry_level is a program-defining knob for the hash.
+    assert config_hash(
+        dataclasses.replace(tiny_config, telemetry_level="detailed")
+    ) != h
+
+
+def test_config_validates_telemetry_level(tiny_config):
+    dataclasses.replace(tiny_config, telemetry_level="detailed").validate()
+    with pytest.raises(ValueError, match="telemetry_level"):
+        dataclasses.replace(tiny_config, telemetry_level="verbose").validate()
+
+
+# ------------------------------------------------------------- integration
+
+
+def _run_with_artifacts(cfg):
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    result = run_simulation(cfg)
+    metrics = glob.glob(
+        os.path.join(cfg.log_root, "**", "metrics.jsonl"), recursive=True
+    )
+    assert len(metrics) == 1
+    with open(metrics[0]) as f:
+        records = [json.loads(line) for line in f]
+    return result, records, os.path.dirname(metrics[0])
+
+
+def test_simulator_telemetry_stable_run(tiny_config, tmp_path):
+    """A shape-stable vmap run: warmup compiles land in the first round's
+    record, every later round reports 0 compiles, phase timings cover the
+    round loop's regions, and the result dict's post_warmup_compiles
+    gate is 0."""
+    cfg = dataclasses.replace(
+        tiny_config, round=3, telemetry_level="basic",
+        compilation_cache_dir=None, log_root=str(tmp_path / "log"),
+    )
+    result, records, artifacts = _run_with_artifacts(cfg)
+    assert result["post_warmup_compiles"] == 0
+    assert result["telemetry_level"] == "basic"
+    assert len(records) == 3
+    assert all(r["schema_version"] == METRICS_SCHEMA_VERSION for r in records)
+    warmup = records[0]["telemetry"]
+    assert warmup["compiles"] > 0
+    assert any("round_fn" in n for n in warmup["compiled"])
+    for r in records[1:]:
+        assert r["telemetry"]["compiles"] == 0
+        assert "compiled" not in r["telemetry"]
+    for r in records:
+        phases = r["telemetry"]["phase_seconds"]
+        assert {"client_step", "eval", "host_sync", "post_round"} <= set(
+            phases
+        )
+        assert all(v >= 0.0 for v in phases.values())
+
+    # Offline reporter over the real artifacts dir (acceptance pin).
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report_run",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "report_run.py"),
+    )
+    report_run = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report_run)
+    summary = report_run.summarize_run(
+        report_run.load_metrics(artifacts)
+    )
+    assert summary["rounds"] == 3
+    assert summary["compiles"]["post_warmup"] == 0
+    assert summary["compiles"]["warmup"] > 0
+    assert summary["final_accuracy"] == records[-1]["test_accuracy"]
+    assert set(summary["phases"]) >= {"client_step", "eval"}
+    assert summary["rejected_rounds"]["count"] == 0
+    rendered = "\n".join(report_run.render_summary(summary))
+    assert "post-warmup recompiles: none" in rendered
+    assert "client_step" in rendered and "accuracy" in rendered
+
+
+def test_simulator_telemetry_off_keeps_v1_records(tiny_config, tmp_path):
+    """telemetry_level='off' (the default) emits the legacy v1 record —
+    exactly the pre-telemetry key set, no schema_version, no telemetry
+    sub-object."""
+    cfg = dataclasses.replace(
+        tiny_config, round=2, log_root=str(tmp_path / "log"),
+    )
+    assert cfg.telemetry_level == "off"
+    result, records, _ = _run_with_artifacts(cfg)
+    assert result["post_warmup_compiles"] is None
+    for r in records:
+        assert set(r) == {
+            "round", "test_accuracy", "test_loss", "mean_client_loss",
+            "round_seconds",
+        }
+
+
+def test_threaded_telemetry_basic(tmp_path):
+    """The threaded oracle reports through the same builder: schema-v2
+    records with server-side phase timings, and a run-level compile
+    count in the result dict."""
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    cfg = ExperimentConfig(
+        dataset_name="synthetic", model_name="mlp",
+        distributed_algorithm="fed", worker_number=2, round=2, epoch=1,
+        learning_rate=0.1, batch_size=32, n_train=128, n_test=64,
+        log_level="WARNING", dataset_args={"difficulty": 0.5},
+        execution_mode="threaded", telemetry_level="basic",
+        compilation_cache_dir=None, log_root=str(tmp_path / "log"),
+    )
+    result = run_simulation(cfg)
+    assert result["xla_compiles"] > 0
+    assert result["telemetry_level"] == "basic"
+    metrics = glob.glob(
+        os.path.join(cfg.log_root, "**", "metrics.jsonl"), recursive=True
+    )
+    with open(metrics[0]) as f:
+        records = [json.loads(line) for line in f]
+    assert len(records) == 2
+    for r in records:
+        assert r["schema_version"] == METRICS_SCHEMA_VERSION
+        assert {"aggregate", "eval", "post_round"} <= set(
+            r["telemetry"]["phase_seconds"]
+        )

@@ -1,0 +1,129 @@
+"""utils/tracing.py: device-trace parsing against synthetic fixtures.
+
+iter_device_ops / parse_device_trace define the event-selection rule the
+bench regression proxy depends on (complete 'X' events with XLA op
+annotations, wrapper ``while``/``jit(`` frames excluded). These tests pin
+that rule with hand-built gzipped ``*.trace.json.gz`` fixtures, so a
+selection-rule regression shows up here instead of as a silently shifted
+proxy baseline.
+"""
+
+import gzip
+import json
+import os
+
+from distributed_learning_simulator_tpu.utils.tracing import (
+    iter_device_ops,
+    parse_device_trace,
+    top_device_ops,
+)
+
+GIB = 2**30
+
+
+def _write_trace(root, events, run="run1", fname="host.trace.json.gz"):
+    """Lay out the jax.profiler directory shape the parser globs:
+    ``<root>/plugins/profile/<run>/<fname>``."""
+    d = os.path.join(root, "plugins", "profile", run)
+    os.makedirs(d, exist_ok=True)
+    with gzip.open(os.path.join(d, fname), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def _op(name, dur_us, nbytes=None, long_name=None):
+    args = {}
+    if nbytes is not None:
+        args["raw_bytes_accessed"] = nbytes
+    if long_name is not None:
+        args["long_name"] = long_name
+    return {"ph": "X", "name": name, "dur": dur_us, "args": args}
+
+
+def test_selection_rule_and_aggregation(tmp_path):
+    """Annotated X events are summed; wrapper frames, non-X phases, and
+    unannotated host events are excluded even when they carry bytes."""
+    events = [
+        _op("fusion.1", 100.0, nbytes=GIB, long_name="fusion kernel"),
+        _op("copy.2", 50.0, nbytes=GIB // 2),
+        # Wrapper frames: would double count their children's bytes/time.
+        _op("while", 1000.0, nbytes=100 * GIB),
+        _op("jit(round_fn)", 800.0, nbytes=100 * GIB, long_name="jit frame"),
+        # Non-X phase events are skipped outright.
+        {"ph": "M", "name": "process_name", "args": {"name": "meta"}},
+        # X event with no op annotation (host lane) is skipped.
+        {"ph": "X", "name": "host_callback", "dur": 5.0},
+        # long_name alone qualifies (CPU traces carry no byte counts).
+        _op("dot.3", 25.0, long_name="dot_general"),
+    ]
+    _write_trace(str(tmp_path), events)
+    ops = list(iter_device_ops(str(tmp_path)))
+    assert sorted(ev["name"] for ev in ops) == [
+        "copy.2", "dot.3", "fusion.1",
+    ]
+    stats = parse_device_trace(str(tmp_path))
+    assert stats["op_count"] == 3
+    assert stats["device_ms"] == (100.0 + 50.0 + 25.0) / 1e3
+    assert stats["bytes_gb"] == (GIB + GIB // 2) / GIB
+
+
+def test_wrapper_exclusion_is_prefix_based(tmp_path):
+    """The exclusion rule is the documented name-PREFIX match: any
+    ``while*``/``jit(*`` name is a wrapper, whatever its suffix."""
+    events = [
+        _op("while.body.fusion", 10.0, nbytes=GIB),  # prefix 'while' -> out
+        _op("jit(train_step)/mul", 10.0, nbytes=GIB),  # prefix 'jit(' -> out
+        _op("jitted_mul", 10.0, nbytes=GIB),  # 'jit' but not 'jit(' -> in
+    ]
+    _write_trace(str(tmp_path), events)
+    names = [ev["name"] for ev in iter_device_ops(str(tmp_path))]
+    assert names == ["jitted_mul"]
+
+
+def test_missing_and_empty_dirs_yield_nothing(tmp_path):
+    """Missing/empty trace dirs parse to zeros, never raise (bench's
+    proxy leg must degrade, not crash, when a trace comes back empty)."""
+    missing = str(tmp_path / "nope")
+    assert list(iter_device_ops(missing)) == []
+    assert parse_device_trace(missing) == {
+        "device_ms": 0.0, "bytes_gb": 0.0, "op_count": 0,
+    }
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert parse_device_trace(str(empty)) == {
+        "device_ms": 0.0, "bytes_gb": 0.0, "op_count": 0,
+    }
+    # A session dir whose trace holds no events at all.
+    _write_trace(str(tmp_path / "blank"), [])
+    assert parse_device_trace(str(tmp_path / "blank"))["op_count"] == 0
+
+
+def test_multiple_trace_files_are_summed(tmp_path):
+    """Every *.trace.json.gz under the dir contributes (the documented
+    one-session-per-dir contract: a reused dir accumulates)."""
+    _write_trace(str(tmp_path), [_op("a", 10.0, nbytes=GIB)],
+                 fname="one.trace.json.gz")
+    _write_trace(str(tmp_path), [_op("b", 20.0, nbytes=GIB)],
+                 fname="two.trace.json.gz")
+    stats = parse_device_trace(str(tmp_path))
+    assert stats["op_count"] == 2
+    assert stats["bytes_gb"] == 2.0
+
+
+def test_top_device_ops_ranks_by_bytes(tmp_path):
+    """top_device_ops aggregates per op name and ranks by bytes with time
+    as tiebreaker — the report_run 'where did the bytes go' table."""
+    events = [
+        _op("fusion.1", 10.0, nbytes=GIB),
+        _op("fusion.1", 10.0, nbytes=GIB),      # same name: aggregated
+        _op("copy.2", 500.0, nbytes=GIB // 4),  # slow but few bytes
+        _op("zerobytes.a", 90.0, long_name="x"),   # 0 B, more time
+        _op("zerobytes.b", 10.0, long_name="y"),   # 0 B, less time
+    ]
+    _write_trace(str(tmp_path), events)
+    top = top_device_ops(str(tmp_path), k=10)
+    assert [t["name"] for t in top] == [
+        "fusion.1", "copy.2", "zerobytes.a", "zerobytes.b",
+    ]
+    assert top[0]["count"] == 2 and top[0]["bytes_gb"] == 2.0
+    assert top_device_ops(str(tmp_path), k=1)[0]["name"] == "fusion.1"
+    assert top_device_ops(str(tmp_path / "missing")) == []

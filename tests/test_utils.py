@@ -215,6 +215,46 @@ def test_profile_from_round_defers_trace(tmp_path, tiny_config):
     assert not os.path.isdir(never)  # trace never started
 
 
+def test_run_artifact_paths_unique_same_second(tmp_path):
+    """Two runs starting within the same second (even the same
+    microsecond, forced via an identical explicit timestamp) must get
+    DISTINCT log files and artifacts dirs — the collision that used to
+    overwrite logs and interleave metrics.jsonl (utils/logging.py keyed
+    paths on int(timestamp))."""
+    import logging as _logging
+    import os
+
+    from distributed_learning_simulator_tpu.utils.logging import (
+        get_logger,
+        set_file_handler,
+        set_run_artifacts,
+    )
+
+    ts = 1700000000.123456
+    p1 = set_file_handler(str(tmp_path), "fed", "mnist", "lenet5",
+                          timestamp=ts)
+    p2 = set_file_handler(str(tmp_path), "fed", "mnist", "lenet5",
+                          timestamp=ts)
+    assert p1 != p2
+    assert os.path.exists(p1) and os.path.exists(p2)
+    # Sub-second precision + pid land in the run id.
+    base = os.path.basename(p1)
+    assert "123456" in base and str(os.getpid()) in base
+
+    a1 = set_run_artifacts(str(tmp_path), "fed", "mnist", "lenet5")
+    a2 = set_run_artifacts(str(tmp_path), "fed", "mnist", "lenet5")
+    assert a1[0] != a2[0] and a1[1] != a2[1]
+    assert os.path.isdir(a1[1]) and os.path.isdir(a2[1])
+
+    # Detach the file sink this test attached (other tests share the
+    # process-global logger).
+    logger = get_logger()
+    for h in [h for h in logger.handlers
+              if isinstance(h, _logging.FileHandler)]:
+        logger.removeHandler(h)
+        h.close()
+
+
 def test_profile_from_round_rejects_negative(tiny_config):
     """profile_from_round < 0 is a config error (caught in validate()
     alongside the other Shapley/profiling knob checks), not a silent
