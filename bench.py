@@ -32,7 +32,14 @@ measured automatically into the ``flagship`` sub-object on default runs;
 BENCH_FLAGSHIP=0 skips it, BENCH_FLAGSHIP_ROUNDS sets its length. The
 converged-GTG round cost at N=1000 (the ``gtg`` sub-object, tracked since
 ISSUE 1's cumulative prefix aggregation) follows the same pattern:
-BENCH_GTG=0 skips, BENCH_GTG_ROUNDS sets its length.
+BENCH_GTG=0 skips, BENCH_GTG_ROUNDS sets its length. The ``client_stats``
+sub-object re-runs the headline program with ``client_stats='on'``
+(telemetry/client_stats.py) and records the relative round-time
+``overhead_ratio`` against the off-mode headline from the SAME bench run
+— scripts/compare_bench.py gates it (--stats-overhead-threshold);
+BENCH_CLIENT_STATS=0 skips, BENCH_CLIENT_STATS_ROUNDS sets its length.
+The client-stats knobs land in ``config_hash`` like every other
+program-defining field.
 """
 
 from __future__ import annotations
@@ -260,6 +267,37 @@ def main():
             "mean_rate": round(fr["mean_rate"], 2),
             "round_ms": {k: round(v, 1) for k, v in fr["round_ms"].items()},
             "compile_s": round(fr["compile_s"], 2),
+        }
+
+    # client_stats=on overhead (ISSUE 4): the SAME headline program plus
+    # the in-round per-client statistics, so overhead_ratio is an
+    # apples-to-apples on-vs-off round-time ratio measured in one bench
+    # run on one machine — the number compare_bench.py's
+    # --stats-overhead-threshold gates.
+    run_cstats = (
+        os.environ.get("BENCH_CLIENT_STATS", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_cstats:
+        cs_rounds = int(os.environ.get("BENCH_CLIENT_STATS_ROUNDS", "5"))
+        cs_config = ExperimentConfig(
+            model_name=model, round=cs_rounds + 1, client_chunk_size=chunk,
+            local_compute_dtype=dtype, client_stats="on",
+            **failure_knobs, **common,
+        )
+        cs_times, cs_result = _run(
+            cs_config, dataset=dataset, client_data=client_data
+        )
+        cr = _rates(cs_times, n_clients)
+        record["client_stats"] = {
+            "value": round(cr["median_rate"], 2),
+            "rounds": cs_rounds,
+            "round_ms": {k: round(v, 1) for k, v in cr["round_ms"].items()},
+            "overhead_ratio": round(
+                cr["round_ms"]["median"] / r["round_ms"]["median"] - 1.0, 4
+            ),
+            "clients_flagged": cs_result["clients_flagged"],
         }
 
     # Converged-GTG round wall-clock at the north-star population (ISSUE 1:

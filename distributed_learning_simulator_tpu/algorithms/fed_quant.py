@@ -32,6 +32,7 @@ compression-ratio reporting. Here:
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
 from distributed_learning_simulator_tpu.ops.payload import (
@@ -43,6 +44,9 @@ from distributed_learning_simulator_tpu.ops.quantize import (
     dequantize_tree,
     fake_quant_tree,
     stochastic_quantize_tree,
+)
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    ClientStats,
 )
 
 
@@ -78,9 +82,30 @@ class FedQuant(FedAvg):
         return jax.vmap(one)(client_params, keys), {}
 
     def process_aggregated(self, global_params, key):
-        """Simulate the quantized downlink broadcast."""
+        """Simulate the quantized downlink broadcast.
+
+        With ``client_stats`` on, also report the per-round mean-squared
+        quantization error of that broadcast (device-side scalar; lands
+        in the ``client_stats`` sub-object of the metrics record) — the
+        payload-compression loss the analytic byte ratios cannot show.
+        Trace-time gated: 'off' compiles the exact pre-feature program.
+        """
         q = stochastic_quantize_tree(global_params, self.levels, key)
-        return dequantize_tree(q), {}
+        deq = dequantize_tree(q)
+        aux = {}
+        if ClientStats.from_config(self.config) is not None:
+            se = sum(
+                jnp.sum((d.astype(jnp.float32) - g.astype(jnp.float32)) ** 2)
+                for g, d in zip(
+                    jax.tree_util.tree_leaves(global_params),
+                    jax.tree_util.tree_leaves(deq),
+                )
+            )
+            count = sum(
+                g.size for g in jax.tree_util.tree_leaves(global_params)
+            )
+            aux["quant_mse"] = se / count
+        return deq, aux
 
     def post_round(self, ctx):
         raw = payload_bytes(ctx.global_params)

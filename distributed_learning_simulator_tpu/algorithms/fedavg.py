@@ -32,6 +32,9 @@ from distributed_learning_simulator_tpu.robustness.faults import (
     FailureModel,
     all_finite,
 )
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    ClientStats,
+)
 
 
 class FedAvg(Algorithm):
@@ -162,6 +165,11 @@ class FedAvg(Algorithm):
         compute_dtype = None
         if getattr(cfg, "local_compute_dtype", "float32") == "bfloat16":
             compute_dtype = jnp.bfloat16
+        # Per-client statistics (telemetry/client_stats.py): every cs-gated
+        # branch below is a TRACE-TIME conditional — client_stats='off'
+        # (the default) compiles the exact pre-feature program, and 'on'
+        # consumes no extra RNG, so the two modes train bit-identically.
+        cs = ClientStats.from_config(cfg)
         local_train = make_local_train_fn(
             apply_fn,
             optimizer,
@@ -172,6 +180,7 @@ class FedAvg(Algorithm):
             preprocess=preprocess,
             augment=get_augment(cfg.augment),
             compute_dtype=compute_dtype,
+            collect_stats=cs is not None,
         )
         vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0, None))
         # keep_client_params (class OR instance level) = the documented
@@ -294,6 +303,11 @@ class FedAvg(Algorithm):
                     cp = fm.corrupt_stack(cp, f_c)
                 if f_c is not None and fm.freezes_client_state:
                     ns = fm.freeze_failed_state(f_c, state_c, ns)
+                if cs is not None:
+                    # Streaming per-chunk upload stats (O(1) scalars + the
+                    # delta probe per client — never the stack), AFTER
+                    # corruption: they describe what the server received.
+                    tm = cs.add_upload_stats(tm, global_params, cp)
                 return reduce_chunk(cp, w_c, pk), (ns, tm)
 
             return compute
@@ -331,6 +345,8 @@ class FedAvg(Algorithm):
                     cp = fm.corrupt_stack(cp, failed)
                 if failed is not None and fm.freezes_client_state:
                     ns = fm.freeze_failed_state(failed, state, ns)
+                if cs is not None:
+                    tm = cs.add_upload_stats(tm, global_params, cp)
                 return reduce_chunk(cp, norm_w, payload_key), ns, tm
 
             # chunked_accumulate handles the reshape/scan/remainder
@@ -359,8 +375,12 @@ class FedAvg(Algorithm):
             client positions."""
             n = keys.shape[0]
             agg = jax.tree_util.tree_map(jnp.zeros_like, global_params)
-            loss = jnp.zeros((n,), jnp.float32)
-            acc = jnp.zeros((n,), jnp.float32)
+            # Per-client metrics scatter back to original client positions;
+            # the dict is keyed by whatever the compute body reports (loss/
+            # accuracy always; the client_stats probe and scalars when on),
+            # with skipped empty clients keeping all-zero rows — identical
+            # to "training" them on fully masked slots.
+            metrics_full = None
             new_state = state
             group_keys = jax.random.split(payload_key, len(plan))
             bsz = cfg.batch_size
@@ -397,13 +417,23 @@ class FedAvg(Algorithm):
                         per_chunk=gk,
                     )
                 agg = jax.tree_util.tree_map(jnp.add, agg, partial)
-                loss = loss.at[idx].set(tm_g["loss"])
-                acc = acc.at[idx].set(tm_g["accuracy"])
+                if metrics_full is None:
+                    metrics_full = jax.tree_util.tree_map(
+                        lambda a: jnp.zeros((n,) + a.shape[1:], a.dtype),
+                        tm_g,
+                    )
+                metrics_full = jax.tree_util.tree_map(
+                    lambda full, g: full.at[idx].set(g), metrics_full, tm_g
+                )
                 if state is not None:
                     new_state = jax.tree_util.tree_map(
                         lambda full, g: full.at[idx].set(g), new_state, ns_g
                     )
-            return agg, new_state, {"loss": loss, "accuracy": acc}
+            # At least one nonzero group always ran: an all-empty cohort
+            # collapses the plan to the single s=0 group, which round_fn
+            # routes to the plain path (len(plan) <= 1 -> plan = None).
+            assert metrics_full is not None
+            return agg, new_state, metrics_full
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
                      lr_scale=1.0):
@@ -477,6 +507,14 @@ class FedAvg(Algorithm):
                     new_state_k = fm.freeze_failed_state(
                         failed, state_k, new_state_k
                     )
+                if cs is not None:
+                    # Same functions as the fused/bucketed chunks, applied
+                    # to the already-resident stack at the same point
+                    # (post-corruption, pre-payload) — the paths stay a
+                    # differential pair for the stats too.
+                    train_metrics = cs.add_upload_stats(
+                        train_metrics, global_params, client_params
+                    )
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )
@@ -536,6 +574,16 @@ class FedAvg(Algorithm):
                 ),
                 new_global, global_params,
             )
+            if cs is not None:
+                # [N, S] per-client stats (telemetry/client_stats.py):
+                # the aggregate-delta probe uses the RAW round aggregate —
+                # before the server optimizer, the downlink transform, and
+                # any quorum rejection select — i.e. the same quantity the
+                # clients' uploads averaged into.
+                aux["client_stats"] = cs.stats_matrix(
+                    train_metrics,
+                    cs.probe_delta(global_params, new_global),
+                )
             if quorum:
                 # Quorum policy: a round is REJECTED — previous global
                 # retained, the event recorded — when honest survivors fall

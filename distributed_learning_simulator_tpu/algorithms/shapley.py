@@ -55,10 +55,40 @@ from distributed_learning_simulator_tpu.ops.aggregate import (
     subset_masks_all,
     subset_weighted_mean,
 )
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    ClientStats,
+    attribution_crosscheck,
+)
 from distributed_learning_simulator_tpu.utils.logging import get_logger
 
 _EVAL_CHUNK = 16  # subset models evaluated per batched XLA call
 _PREFIX_BLOCK = 16  # GTG permutation prefixes fetched per fused call
+
+
+def _sv_crosscheck_extra(ctx: RoundContext, sv_arr, config) -> dict:
+    """Utility-attribution cross-check (telemetry/client_stats.py): when
+    the round carried per-client stats, report the correlation between
+    the expensive Shapley attribution and the cheap in-round signal
+    (local loss improvement). Reads the matrix the host loop ALREADY
+    fetched (ctx.extra, populated only on client_stats_every cadence
+    rounds — no second device transfer, and off-cadence rounds don't
+    grow a v3-era field in their un-upgraded record); falls back to the
+    device array for direct post_round callers, cadence-gated the same
+    way. Empty dict when stats are off, off-cadence, or the correlation
+    is degenerate."""
+    stats = ctx.extra.get("client_stats_np")
+    if stats is None:
+        stats_dev = ctx.aux.get("client_stats")
+        cs = ClientStats.from_config(config)
+        if (
+            stats_dev is None
+            or cs is None
+            or not cs.fetch_round(ctx.round_idx)
+        ):
+            return {}
+        stats = np.asarray(stats_dev)
+    corr = attribution_crosscheck(sv_arr, stats)
+    return {} if corr is None else {"sv_stats_corr": corr}
 
 
 def _resolve_eval_dtype(config, default: str) -> str:
@@ -541,7 +571,10 @@ class MultiRoundShapley(FedAvg):
             with open(path, "wb") as f:
                 pickle.dump({tuple(sorted(k)): v for k, v in utilities.items()}, f)
         logger.info("round %d shapley values: %s", round_idx, sv)
-        return {"shapley_values": sv}
+        return {
+            "shapley_values": sv,
+            **_sv_crosscheck_extra(ctx, sv_arr, self.config),
+        }
 
 
 class GTGShapley(FedAvg):
@@ -844,4 +877,5 @@ class GTGShapley(FedAvg):
             # a converged round is the honest cost unit (a fixed-budget
             # Monte-Carlo round is cheaper but a different estimator).
             "gtg_converged": converged,
+            **_sv_crosscheck_extra(ctx, sv_arr, self.config),
         }

@@ -42,6 +42,9 @@ from distributed_learning_simulator_tpu.robustness.faults import (
     FailureModel,
     all_finite,
 )
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    ClientStats,
+)
 
 
 class SignSGD(Algorithm):
@@ -141,6 +144,13 @@ class SignSGD(Algorithm):
         fm = FailureModel.from_config(cfg)
         min_survivors = getattr(cfg, "min_survivors", 0)
         quorum = fm is not None or min_survivors > 0
+        # Per-client stats (telemetry/client_stats.py): sign_SGD keeps ONE
+        # shared params tree, so there is no per-client delta to score —
+        # instead expose the per-step majority-vote agreement fraction
+        # (computed and thrown away inside the vote until now) as a round
+        # statistic. Trace-time gated like the failure model: 'off'
+        # compiles the exact pre-feature program.
+        cs = ClientStats.from_config(cfg)
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
                      lr_scale=1.0):
@@ -299,15 +309,40 @@ class SignSGD(Algorithm):
                     else:
                         step_inc = 1
                         denom = n_clients
-                    return (new_params, momenta_new, step_counts + step_inc), (
-                        loss_sum / denom
-                    )
+                    step_out = loss_sum / denom
+                    if cs is not None:
+                        # Majority-vote agreement fraction: a coordinate
+                        # with vote sum v over V voters has (V + |v|) / 2
+                        # voters agreeing with the majority, so the mean
+                        # agreement over all P coordinates is
+                        # 1/2 + mean|v| / (2V). 1.0 = unanimous step,
+                        # 0.5 = coin-flip gradient directions.
+                        n_params_total = sum(
+                            v.size
+                            for v in jax.tree_util.tree_leaves(vote_sum)
+                        )
+                        abs_sum = sum(
+                            jnp.sum(jnp.abs(v).astype(jnp.float32))
+                            for v in jax.tree_util.tree_leaves(vote_sum)
+                        )
+                        agree = 0.5 + abs_sum / (
+                            2.0 * denom * n_params_total
+                        )
+                        step_out = (step_out, agree)
+                    return (
+                        new_params, momenta_new, step_counts + step_inc
+                    ), step_out
 
-                (params, momenta, step_counts), step_losses = jax.lax.scan(
+                (params, momenta, step_counts), step_outs = jax.lax.scan(
                     step_body, (params, momenta, step_counts),
                     jnp.arange(steps_per_epoch),
                 )
-                return (params, momenta, step_counts), jnp.mean(step_losses)
+                if cs is not None:
+                    step_losses, step_agree = step_outs
+                    return (params, momenta, step_counts), (
+                        jnp.mean(step_losses), jnp.mean(step_agree)
+                    )
+                return (params, momenta, step_counts), jnp.mean(step_outs)
 
             epoch_keys = jax.random.split(key, epochs)
             if has_momentum:
@@ -317,13 +352,21 @@ class SignSGD(Algorithm):
                 momenta0 = None
                 steps0 = jnp.zeros(n_clients, jnp.int32)
             carry0 = (global_params, momenta0, steps0)
-            (params, momenta, step_counts), epoch_losses = jax.lax.scan(
+            (params, momenta, step_counts), epoch_outs = jax.lax.scan(
                 epoch_body, carry0, epoch_keys
             )
+            if cs is not None:
+                epoch_losses, epoch_agree = epoch_outs
+            else:
+                epoch_losses = epoch_outs
             aux = {
                 "mean_client_loss": epoch_losses[-1],
                 "sync_steps": jnp.asarray(epochs * steps_per_epoch),
             }
+            if cs is not None:
+                # Round-mean vote agreement (per-step fractions averaged
+                # over the round's epochs x steps).
+                aux["vote_agreement"] = jnp.mean(epoch_agree)
             if quorum:
                 # Quorum policy (mirrors fedavg.round_fn): reject the round
                 # — revert to the round-start params — when survivors fall

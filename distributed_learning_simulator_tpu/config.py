@@ -21,6 +21,10 @@ from typing import Any
 # the package re-exports it.
 TELEMETRY_LEVELS = ("off", "basic", "detailed")
 
+# Valid client_stats values (telemetry/client_stats.py). Same
+# import-light placement rationale as TELEMETRY_LEVELS.
+CLIENT_STATS_LEVELS = ("off", "on")
+
 
 @dataclass
 class ExperimentConfig:
@@ -279,6 +283,33 @@ class ExperimentConfig:
     # time; fencing defeats round pipelining's transfer/compute overlap —
     # a measurement mode, not a production mode.
     telemetry_level: str = "off"
+    # --- per-client statistics (telemetry/client_stats.py) ------------------
+    # "off" (default): zero instrumentation — the round program is the
+    # exact pre-feature program (same RNG streams, same HLO) and
+    # metrics.jsonl records stay at schema v2 or below. "on": the round
+    # program additionally computes a compact per-client f32 stats vector
+    # (loss before/after, update L2 norm, grad norm, cosine against the
+    # aggregate delta, non-finite element count) via streaming per-chunk
+    # reductions — works on the fused and bucketed aggregation paths
+    # without materializing the per-client parameter stack — stacked
+    # [N, S] on device; a host-side median/MAD detector flags anomalous
+    # clients per round (flagged_clients / flag_reason in the schema-v3
+    # metrics record). sign_SGD reports its per-step majority-vote
+    # agreement fraction instead (one shared params tree — there is no
+    # per-client delta); fed_quant adds the downlink quantization MSE.
+    client_stats: str = "off"
+    # Fetch cadence: the [N, S] matrix is computed on device every round
+    # but transferred to host (inside the round's single metric fetch, so
+    # async dispatch is preserved) only on rounds where
+    # round_idx % client_stats_every == 0.
+    client_stats_every: int = 1
+    # Coordinates in the strided per-client delta probe used for the
+    # aggregate-cosine statistic (exact when the model has <= this many
+    # parameters); norms and non-finite counts are always exact.
+    client_stats_probe: int = 4096
+    # Robust z-score threshold of the median/MAD detector; lower = more
+    # sensitive (see docs/OBSERVABILITY.md § detector tuning).
+    client_stats_mad_threshold: float = 8.0
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
     # First round the profile trace covers (earlier rounds run untraced).
@@ -469,6 +500,17 @@ class ExperimentConfig:
                 f"unknown telemetry_level {self.telemetry_level!r}; known: "
                 + ", ".join(TELEMETRY_LEVELS)
             )
+        if self.client_stats.lower() not in CLIENT_STATS_LEVELS:
+            raise ValueError(
+                f"unknown client_stats {self.client_stats!r}; known: "
+                + ", ".join(CLIENT_STATS_LEVELS)
+            )
+        if self.client_stats_every < 1:
+            raise ValueError("client_stats_every must be >= 1")
+        if self.client_stats_probe < 1:
+            raise ValueError("client_stats_probe must be >= 1")
+        if self.client_stats_mad_threshold <= 0.0:
+            raise ValueError("client_stats_mad_threshold must be > 0")
         if self.profile_from_round < 0:
             raise ValueError(
                 f"profile_from_round must be >= 0, got "

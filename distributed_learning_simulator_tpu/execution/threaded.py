@@ -65,7 +65,9 @@ from distributed_learning_simulator_tpu.runtime.native import (
     NativeThreadPool,
 )
 from distributed_learning_simulator_tpu.telemetry import (
+    ClientStats,
     RecompileMonitor,
+    detect_and_record,
     make_phase_timer,
     peak_hbm_bytes,
 )
@@ -97,6 +99,17 @@ class _QueueServerBase:
         # as the vmap path ('client_step' has no server-side analogue here
         # — local training runs on the worker threads).
         self._phase_timer = make_phase_timer(self.config.telemetry_level)
+        # Per-client statistics (telemetry/client_stats.py): the threaded
+        # server holds the full upload stack at its rendezvous barrier, so
+        # the stats come straight off it. Its workers report no losses —
+        # those columns are NaN (rendered null) and the detector skips
+        # them; the update-norm / cosine / non-finite columns and the
+        # flagging flow through the same shared record builder as the
+        # vmap path. None at the default 'off'.
+        self._client_stats = ClientStats.from_config(self.config)
+        # Run total for the result dict, mirroring the vmap path's
+        # clients_flagged contract.
+        self.clients_flagged = 0
         self.result_queues = [
             NativeTaskQueue() for _ in range(self.worker_number)
         ]
@@ -104,23 +117,28 @@ class _QueueServerBase:
             worker_fun=self._guarded_worker_fun
         )
 
-    def _finish_record(self, record: dict, round_idx: int) -> dict:
-        """Fold the round's telemetry into the metrics record through the
-        shared schema-versioned builder (utils/reporting.py); at
-        telemetry_level='off' the legacy v1 record passes through
-        unchanged."""
-        if not self._phase_timer.enabled:
+    def _finish_record(self, record: dict, round_idx: int,
+                       client_stats: dict | None = None) -> dict:
+        """Fold the round's telemetry + client stats into the metrics
+        record through the shared schema-versioned builder
+        (utils/reporting.py); with telemetry_level='off' and no client
+        stats the legacy v1 record passes through unchanged."""
+        tel = None
+        if self._phase_timer.enabled:
+            tel = {
+                "phase_seconds": {
+                    k: round(v, 6)
+                    for k, v in sorted(
+                        self._phase_timer.take(round_idx).items()
+                    )
+                },
+            }
+            peak = peak_hbm_bytes()
+            if peak is not None:
+                tel["peak_hbm_bytes"] = peak
+        if tel is None and client_stats is None:
             return record
-        tel = {
-            "phase_seconds": {
-                k: round(v, 6)
-                for k, v in sorted(self._phase_timer.take(round_idx).items())
-            },
-        }
-        peak = peak_hbm_bytes()
-        if peak is not None:
-            tel["peak_hbm_bytes"] = peak
-        return build_round_record(record, tel)
+        return build_round_record(record, tel, client_stats)
 
     def _guarded_worker_fun(self, data, extra_args):
         """Server-callback errors must tear the rendezvous down, not kill
@@ -237,6 +255,21 @@ class ThreadedServer(_QueueServerBase):
                 ])))
                 if not finite:
                     aggregated = self.prev_model
+            cs_rec = None
+            if (
+                self._client_stats is not None
+                and self._client_stats.fetch_round(self._round)
+            ):
+                # Stats on the raw (pre-downlink) aggregate, same point
+                # as the vmap path's probe; the threaded oracle refuses
+                # failure models, so this is diagnostics, not defense.
+                cs_rec, n_flagged = detect_and_record(
+                    jax.device_get(self._client_stats.stack_stats(
+                        self.prev_model, stacked, aggregated
+                    )),
+                    self._client_stats, self._round, logger=get_logger(),
+                )
+                self.clients_flagged += n_flagged
             aggregated = self._process_aggregated_parameter(aggregated)
             _ph.fence(aggregated)
         with self._phase_timer.phase(self._round, "eval"):
@@ -258,7 +291,8 @@ class ThreadedServer(_QueueServerBase):
             **self._record_extra(aggregated),
             **extra_post,
         }
-        record = self._finish_record(record, self._round)
+        record = self._finish_record(record, self._round,
+                                     client_stats=cs_rec)
         self.history.append(record)
         if self.metrics_path:
             with open(self.metrics_path, "a") as f:
@@ -903,6 +937,13 @@ def run_threaded_simulation(
         "client_rounds_per_sec": config.round * n / max(total, 1e-9),
         "telemetry_level": config.telemetry_level.lower(),
         "xla_compiles": xla_compiles,
+        # Same contract as the vmap path: total detector flags over the
+        # run, None when client_stats is off. (The sign_SGD server
+        # computes no per-client stats, so its total is simply 0.)
+        "clients_flagged": (
+            getattr(server, "clients_flagged", 0)
+            if ClientStats.from_config(config) is not None else None
+        ),
     }
 
 

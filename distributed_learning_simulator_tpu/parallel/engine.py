@@ -134,6 +134,7 @@ def make_local_train_fn(
     preprocess: Callable | None = None,
     augment: Callable | None = None,
     compute_dtype=None,
+    collect_stats: bool = False,
 ):
     """Build ``local_train(params, opt_state, xs, ys, mask, key)``.
 
@@ -155,6 +156,13 @@ def make_local_train_fn(
     aggregation accumulates client params in f32 (fedavg.py reduce_chunk),
     so precision loss is confined to a few local SGD steps, the regime where
     bf16 training is standard practice.
+
+    ``collect_stats`` (telemetry/client_stats.py): additionally report
+    ``loss_first`` (the very first optimizer step's batch loss — the
+    local loss at the incoming global params) and ``grad_sq_mean`` (mean
+    per-step squared gradient L2 norm) in the metrics dict. A trace-time
+    flag: False (the default) compiles the exact pre-feature program and
+    consumes no extra RNG either way.
     """
     loss_fn = make_loss_fn(apply_fn, param_transform)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -244,24 +252,52 @@ def make_local_train_fn(
                     params, sr_state = _sr_tree_to_bf16(summed, sr_state)
                 else:
                     params = optax.apply_updates(params, updates)
-                return (params, opt_state, sr_state), (loss, acc)
+                step_out = (loss, acc)
+                if collect_stats:
+                    # Exact per-step gradient L2 norm (f32 even when the
+                    # local run computes in bf16).
+                    grad_sq = sum(
+                        jnp.sum(g.astype(jnp.float32) ** 2)
+                        for g in jax.tree_util.tree_leaves(grads)
+                    )
+                    step_out = (loss, acc, grad_sq)
+                return (params, opt_state, sr_state), step_out
 
-            (params, opt_state, sr_state), (losses, accs) = jax.lax.scan(
+            (params, opt_state, sr_state), step_outs = jax.lax.scan(
                 step_body, (params, opt_state, sr_state),
                 jnp.arange(steps_per_epoch),
             )
-            return (params, opt_state, sr_state), (
-                jnp.mean(losses), jnp.mean(accs)
-            )
+            if collect_stats:
+                losses, accs, grad_sqs = step_outs
+                epoch_out = (
+                    jnp.mean(losses), jnp.mean(accs),
+                    losses[0], jnp.mean(grad_sqs),
+                )
+            else:
+                losses, accs = step_outs
+                epoch_out = (jnp.mean(losses), jnp.mean(accs))
+            return (params, opt_state, sr_state), epoch_out
 
         epoch_keys = jax.random.split(key, local_epochs)
-        (params, opt_state, sr_state), (epoch_losses, epoch_accs) = (
+        (params, opt_state, sr_state), epoch_outs = (
             jax.lax.scan(
                 epoch_body, (params, opt_state, sr_state),
                 (epoch_keys, jnp.arange(local_epochs)),
             )
         )
-        metrics = {"loss": epoch_losses[-1], "accuracy": epoch_accs[-1]}
+        if collect_stats:
+            epoch_losses, epoch_accs, first_losses, grad_means = epoch_outs
+            metrics = {
+                "loss": epoch_losses[-1],
+                "accuracy": epoch_accs[-1],
+                # First epoch's first step: the loss of the INCOMING
+                # global params on this client's first batch.
+                "loss_first": first_losses[0],
+                "grad_sq_mean": jnp.mean(grad_means),
+            }
+        else:
+            epoch_losses, epoch_accs = epoch_outs
+            metrics = {"loss": epoch_losses[-1], "accuracy": epoch_accs[-1]}
         return params, (None if reset_optimizer else opt_state), metrics
 
     return local_train

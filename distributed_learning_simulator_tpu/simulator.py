@@ -57,7 +57,9 @@ from distributed_learning_simulator_tpu.parallel.mesh import (
 )
 from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
 from distributed_learning_simulator_tpu.telemetry import (
+    ClientStats,
     RecompileMonitor,
+    detect_and_record,
     hbm_limit_bytes,
     log_round_compiles,
     make_phase_timer,
@@ -648,6 +650,13 @@ def run_simulation(
     phase_timer = make_phase_timer(tel_level)
     recompile = RecompileMonitor() if tel_level != "off" else None
     post_warmup_compiles = {"count": 0} if recompile is not None else None
+    # Per-client statistics (telemetry/client_stats.py): the round program
+    # computes the [N, S] stats matrix in-program when on; the host fetches
+    # it on the client_stats_every cadence inside the round's single metric
+    # device_get, runs the median/MAD detector, and folds the result into
+    # the schema-v3 record. None at the default 'off'.
+    client_stats_cfg = ClientStats.from_config(config)
+    telemetry["clients_flagged"] = 0
 
     def finalize(p: dict) -> None:
         nonlocal prev_metrics, t_prev_done
@@ -655,12 +664,23 @@ def run_simulation(
             k for k in ("survivor_count", "round_rejected", "participants")
             if k in p["aux"]
         ]
+        # Client-stats fetch cadence (client_stats_every): the [N, S]
+        # matrix and its round scalars ride the round's SINGLE metric
+        # device_get below — no extra host sync, async dispatch preserved.
+        cs_fetch = (
+            client_stats_cfg is not None
+            and client_stats_cfg.fetch_round(p["round_idx"])
+        )
+        cs_keys = [
+            k for k in ("client_stats", "quant_mse", "vote_agreement")
+            if k in p["aux"]
+        ] if cs_fetch else []
         with phase_timer.phase(p["round_idx"], "host_sync"), _oom_hint(
                 config, p["new_global"], n_clients,
                 site="deferred metric fetch"):
             fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
                 (p["metrics_dev"], p["mean_loss_dev"],
-                 {k: p["aux"][k] for k in tel_keys})
+                 {k: p["aux"][k] for k in tel_keys + cs_keys})
             )
         metrics = {k: float(v) for k, v in fetched_metrics.items()}
         ctx = RoundContext(
@@ -674,6 +694,13 @@ def run_simulation(
             eval_batches=eval_batches,
             log_dir=log_dir,
         )
+        if "client_stats" in fetched_tel:
+            # Hand post_round hooks (Shapley's attribution cross-check)
+            # the ALREADY-fetched matrix so they never re-transfer the
+            # device array the single metric device_get above carried.
+            ctx.extra["client_stats_np"] = np.asarray(
+                fetched_tel["client_stats"]
+            )
         with annotate("post_round"), phase_timer.phase(
                 p["round_idx"], "post_round"):
             extra = algorithm.post_round(ctx) or {}
@@ -718,12 +745,39 @@ def run_simulation(
                 ).tobytes()
             )
         t_prev_done = now
+        cs_rec = None
+        if cs_keys:
+            extras = {
+                k: float(fetched_tel[k])
+                for k in ("quant_mse", "vote_agreement")
+                if k in fetched_tel
+            }
+            if "client_stats" in fetched_tel:
+                cs_rec, n_flagged = detect_and_record(
+                    fetched_tel["client_stats"], client_stats_cfg,
+                    p["round_idx"], logger=logger,
+                    participants=fetched_tel.get("participants"),
+                    extras=extras,
+                )
+                telemetry["clients_flagged"] += n_flagged
+            elif extras:
+                # Algorithms without per-client deltas (sign_SGD) report
+                # round scalars only; non-finite values become null like
+                # every other client-stats field (strict-JSON contract).
+                cs_rec = {
+                    "n_clients": n_clients,
+                    **{
+                        k: (v if np.isfinite(v) else None)
+                        for k, v in extras.items()
+                    },
+                }
+        tel_rec = None
         if phase_timer.enabled:
             # Attribute post_round/host-side compiles, then fold this
-            # round's telemetry into a schema-v2 record (shared builder:
-            # utils/reporting.py). Warmup = the first EXECUTED round (it
-            # legitimately compiles the round + eval programs); anything
-            # later is the shape-instability warning.
+            # round's telemetry into a schema-v2/v3 record (shared
+            # builder: utils/reporting.py). Warmup = the first EXECUTED
+            # round (it legitimately compiles the round + eval programs);
+            # anything later is the shape-instability warning.
             recompile.attribute(p["round_idx"])
             events = recompile.take(p["round_idx"])
             n_compiles = log_round_compiles(
@@ -746,7 +800,8 @@ def run_simulation(
             peak = peak_hbm_bytes()
             if peak is not None:
                 tel_rec["peak_hbm_bytes"] = peak
-            record = build_round_record(record, tel_rec)
+        if tel_rec is not None or cs_rec is not None:
+            record = build_round_record(record, tel_rec, cs_rec)
         history.append(record)
         if metrics_path:
             with open(metrics_path, "a") as f:
@@ -995,6 +1050,13 @@ def run_simulation(
         "mean_survivor_count": (
             float(np.mean(telemetry["survivor_counts"]))
             if telemetry["survivor_counts"] else None
+        ),
+        # Client statistics (telemetry/client_stats.py): total clients
+        # flagged by the per-round anomaly detector over the run — 0 on a
+        # clean run; None when client_stats is off.
+        "clients_flagged": (
+            telemetry["clients_flagged"]
+            if client_stats_cfg is not None else None
         ),
         "preempted_at": preempted_at,
     }

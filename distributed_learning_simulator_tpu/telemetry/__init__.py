@@ -18,13 +18,30 @@ engine) reports through:
 * :mod:`.memory` — the ONE ``memory_stats()`` probe (HBM watermark +
   capacity), replacing the ad-hoc call sites that used to be duplicated
   in simulator.py and scripts/measure_gtg_scale.py.
+* :mod:`.client_stats` — trace-time-gated per-client training
+  statistics computed INSIDE the compiled round (streaming reductions;
+  no materialized per-client stack), a host-side median/MAD anomaly
+  detector attributing which clients drove or corrupted a round, and
+  the ``client_stats`` sub-object of the schema-v3 metrics record.
 
 Records land in ``metrics.jsonl`` through the schema-versioned builder in
 ``utils/reporting.py``; ``scripts/report_run.py`` renders an artifacts
 dir offline. Levels, schema, and interpretation: docs/OBSERVABILITY.md.
 """
 
-from distributed_learning_simulator_tpu.config import TELEMETRY_LEVELS
+from distributed_learning_simulator_tpu.config import (
+    CLIENT_STATS_LEVELS,
+    TELEMETRY_LEVELS,
+)
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    PER_CLIENT_CAP,
+    STAT_FIELDS,
+    ClientStats,
+    attribution_crosscheck,
+    client_stats_record,
+    detect_and_record,
+    detect_anomalies,
+)
 from distributed_learning_simulator_tpu.telemetry.memory import (
     device_memory_stats,
     hbm_limit_bytes,
@@ -41,10 +58,18 @@ from distributed_learning_simulator_tpu.telemetry.recompile import (
 )
 
 __all__ = [
+    "CLIENT_STATS_LEVELS",
+    "PER_CLIENT_CAP",
+    "STAT_FIELDS",
     "TELEMETRY_LEVELS",
+    "ClientStats",
     "NullPhaseTimer",
     "PhaseTimer",
     "RecompileMonitor",
+    "attribution_crosscheck",
+    "client_stats_record",
+    "detect_and_record",
+    "detect_anomalies",
     "device_memory_stats",
     "hbm_limit_bytes",
     "log_round_compiles",

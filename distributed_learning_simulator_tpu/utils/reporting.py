@@ -17,10 +17,15 @@ import json
 # metrics.jsonl layout version. v1 (implicit — no version field) is the
 # pre-telemetry record: round/test_accuracy/test_loss/… only. v2 adds
 # ``schema_version`` and the ``telemetry`` sub-object (phase_seconds,
-# compiles, peak_hbm_bytes; docs/OBSERVABILITY.md). telemetry_level='off'
-# keeps emitting v1 byte-for-byte so longitudinal tooling never sees a
-# layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 2
+# compiles, peak_hbm_bytes; docs/OBSERVABILITY.md). v3 adds the
+# ``client_stats`` sub-object (per-client quantile summaries, flagged
+# ids + reasons; telemetry/client_stats.py). A record is stamped with
+# the LOWEST version that describes it: telemetry_level='off' keeps
+# emitting v1 byte-for-byte, and client_stats='off' keeps telemetry-only
+# records at v2 byte-for-byte — longitudinal tooling never sees a layout
+# change it didn't opt into.
+METRICS_SCHEMA_VERSION = 3
+_TELEMETRY_ONLY_SCHEMA_VERSION = 2
 
 # bench.py output version. v1 (implicit) had no provenance; v2 stamps
 # ``schema_version`` + ``config_hash`` so scripts/compare_bench.py can
@@ -39,6 +44,12 @@ _NON_PROGRAM_FIELDS = (
     "round",
     "log_root",
     "log_level",
+    # Host-side detector sensitivity only (telemetry/client_stats.py):
+    # never touches the compiled program or any measured cost, so tuning
+    # it must not make bench runs incomparable. The other client-stats
+    # knobs (on/off, cadence, probe size) DO change the program or its
+    # transfer volume and stay in the hash.
+    "client_stats_mad_threshold",
     "compilation_cache_dir",
     "profile_dir",
     "profile_from_round",
@@ -50,20 +61,30 @@ _NON_PROGRAM_FIELDS = (
 )
 
 
-def build_round_record(base: dict, telemetry: dict | None = None) -> dict:
+def build_round_record(base: dict, telemetry: dict | None = None,
+                       client_stats: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
-    ``telemetry=None`` (``telemetry_level='off'``) returns ``base``
-    unchanged — the legacy v1 layout, byte-identical to pre-telemetry
-    builds. A telemetry dict upgrades the record to v2: ``schema_version``
-    plus the ``telemetry`` sub-object.
+    Both sub-objects ``None`` (``telemetry_level='off'``,
+    ``client_stats='off'``) returns ``base`` unchanged — the legacy v1
+    layout, byte-identical to pre-telemetry builds. A telemetry dict
+    alone upgrades the record to v2 (``schema_version`` + the
+    ``telemetry`` sub-object — byte-identical to pre-client-stats v2
+    builds); a client_stats dict (telemetry/client_stats.py
+    ``client_stats_record``) upgrades it to v3.
     """
-    if telemetry is None:
+    if telemetry is None and client_stats is None:
         return base
     record = dict(base)
-    record["schema_version"] = METRICS_SCHEMA_VERSION
-    record["telemetry"] = telemetry
+    record["schema_version"] = (
+        METRICS_SCHEMA_VERSION if client_stats is not None
+        else _TELEMETRY_ONLY_SCHEMA_VERSION
+    )
+    if telemetry is not None:
+        record["telemetry"] = telemetry
+    if client_stats is not None:
+        record["client_stats"] = client_stats
     return record
 
 

@@ -91,14 +91,26 @@ def parse_device_trace(trace_dir: str) -> dict:
     }
 
 
-def top_device_ops(trace_dir: str, k: int = 10) -> list[dict]:
-    """Top-``k`` device ops by bytes accessed (time as tiebreaker),
-    aggregated by op name over :func:`iter_device_ops`.
+def top_device_ops(trace_dir: str, k: int = 10,
+                   by: str = "bytes") -> list[dict]:
+    """Top-``k`` device ops aggregated by op name over
+    :func:`iter_device_ops`, ranked ``by`` "bytes" (time as tiebreaker —
+    the default) or "time" (bytes as tiebreaker).
 
-    The offline run reporter (scripts/report_run.py) renders this as the
-    "where did the bytes go" table; same selection rule as the bench
-    proxy, so an op that moves the proxy total is findable by name here.
+    The offline run reporter (scripts/report_run.py) renders both
+    rankings — "where did the bytes go" and "where did the time go";
+    same selection rule as the bench proxy, so an op that moves the
+    proxy total is findable by name here. The bytes ranking is the
+    deterministic one (bytes are a program property); the time ranking
+    reflects the traced run's actual schedule, noise included.
     """
+    return _rank_ops(_aggregate_device_ops(trace_dir), k, by)
+
+
+def _aggregate_device_ops(trace_dir: str) -> dict[str, dict]:
+    """Per-op-name byte/time/count aggregation over ONE pass of
+    :func:`iter_device_ops` (the gzipped trace read is the expensive
+    part — callers wanting several rankings aggregate once)."""
     agg: dict[str, dict] = {}
     for ev in iter_device_ops(trace_dir):
         args = ev.get("args") or {}
@@ -112,12 +124,37 @@ def top_device_ops(trace_dir: str, k: int = 10) -> list[dict]:
         entry["count"] += 1
     for entry in agg.values():
         entry["bytes_gb"] = entry["bytes_gb"] / 2**30
+    return agg
+
+
+def _rank_ops(agg: dict[str, dict], k: int, by: str) -> list[dict]:
+    if by not in ("bytes", "time"):
+        raise ValueError(f"by must be 'bytes' or 'time', got {by!r}")
     ranked = sorted(
         agg.values(),
-        key=lambda e: (e["bytes_gb"], e["device_ms"]),
+        key=(
+            (lambda e: (e["bytes_gb"], e["device_ms"])) if by == "bytes"
+            else (lambda e: (e["device_ms"], e["bytes_gb"]))
+        ),
         reverse=True,
     )
     return ranked[:k]
+
+
+def device_op_report(trace_dir: str, k: int = 10) -> dict:
+    """Everything the offline reporter needs from a trace dir in ONE
+    gzip pass: ``{"totals", "by_bytes", "by_time"}`` — the
+    :func:`parse_device_trace` totals plus both top-op rankings."""
+    agg = _aggregate_device_ops(trace_dir)
+    return {
+        "totals": {
+            "device_ms": sum(e["device_ms"] for e in agg.values()),
+            "bytes_gb": sum(e["bytes_gb"] for e in agg.values()),
+            "op_count": sum(e["count"] for e in agg.values()),
+        },
+        "by_bytes": _rank_ops(agg, k, "bytes"),
+        "by_time": _rank_ops(agg, k, "time"),
+    }
 
 
 def annotate(name: str):

@@ -23,6 +23,15 @@ headline/flagship rates (higher is better), converged-GTG round seconds
 byte-exact program properties, so ANY growth beyond the threshold is a
 real program change), rejected-round and survivor robustness counters.
 
+One in-record gate runs on the NEW record alone: its ``client_stats``
+sub-object already holds the on-vs-off round-time overhead measured
+within that single bench run (bench.py re-runs the headline program
+with client_stats='on'), so an overhead above
+``--stats-overhead-threshold`` is a regression regardless of the old
+record — the feature's promise is "cheap enough to leave on". The
+ratio is judged ABSOLUTELY, never as a tracked relative metric: it
+hovers near zero, where relative changes are pure noise.
+
 Deliberately imports nothing heavy (no jax): usable as a CI gate and
 fast enough to self-test in tier-1 (tests/test_compare_bench.py).
 """
@@ -47,6 +56,11 @@ TRACKED = [
     ("proxy_flagship.traced_op_count", "lower", "flagship traced op count"),
     ("robustness.rounds_rejected", "lower", "quorum-rejected rounds"),
     ("robustness.mean_survivor_count", "higher", "mean survivor count"),
+    # client_stats.overhead_ratio is deliberately NOT tracked here: it is
+    # the difference of two noisy medians hovering near zero, so a
+    # relative-change gate on it would flap (0.01 -> 0.02 reads as
+    # +100%). The absolute in-record gate (overhead_gate) is the designed
+    # mechanism.
 ]
 
 
@@ -114,6 +128,24 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
     return out
 
 
+def overhead_gate(record: dict, threshold: float) -> dict | None:
+    """In-record client-stats overhead gate (see module docstring): the
+    regression entry when the record's own measured ``client_stats=on``
+    overhead exceeds ``threshold``, else None (absent leg included)."""
+    ratio = get_path(record, "client_stats.overhead_ratio")
+    if ratio is None or ratio <= threshold:
+        return None
+    return {
+        "metric": "client_stats.overhead_ratio",
+        "description": (
+            "client_stats=on round-time overhead vs the same run's "
+            "off-mode headline"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "lower",
+    }
+
+
 def _fmt(entry: dict) -> str:
     rel = entry["relative_change"]
     rel_s = f"{rel:+.1%}" if rel is not None else "n/a"
@@ -134,6 +166,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="relative regression tolerance (default 0.05)")
     ap.add_argument("--force", action="store_true",
                     help="compare even when provenance says incomparable")
+    ap.add_argument("--stats-overhead-threshold", type=float, default=0.10,
+                    help="max tolerated client_stats=on round-time overhead "
+                         "ratio in the NEW record (default 0.10)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable comparison as JSON")
     args = ap.parse_args(argv)
@@ -155,6 +190,9 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     result = compare_records(old, new, threshold=args.threshold)
+    gate = overhead_gate(new, args.stats_overhead_threshold)
+    if gate is not None:
+        result["regressions"].append(gate)
     if args.json:
         print(json.dumps(result, indent=2))
     else:

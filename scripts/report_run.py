@@ -7,16 +7,21 @@ Input is the per-run artifacts directory the simulator writes
 (``log/<algo>/<dataset>/<model>/<run-id>_artifacts`` containing
 ``metrics.jsonl``) or a ``metrics.jsonl`` path directly. Renders a
 terminal summary — accuracy curve, per-round phase-time breakdown,
-compile events, rejected rounds, peak HBM — and optionally writes the
-same content as machine-readable JSON (``--json``). ``--trace`` points
-at a ``jax.profiler`` trace directory (``config.profile_dir``) and adds
-the deterministic device-op totals plus a top-ops-by-bytes table (same
-selection rule as bench.py's regression proxy: utils/tracing.py).
+compile events, rejected rounds, peak HBM, and (schema v3) a
+client-health section: the anomaly-flag table, a divergence timeline
+over the per-round update-norm spread, and per-client loss sparklines
+when the records carry raw per-client values (cohorts up to the
+per-client cap; telemetry/client_stats.py). Optionally writes the same
+content as machine-readable JSON (``--json``). ``--trace`` points at a
+``jax.profiler`` trace directory (``config.profile_dir``) and adds the
+deterministic device-op totals plus top-ops-by-bytes AND
+top-ops-by-time tables (same selection rule as bench.py's regression
+proxy: utils/tracing.py).
 
-Reads both metrics schemas: v1 (pre-telemetry; accuracy/timing only) and
-v2 (``telemetry`` sub-object — see docs/OBSERVABILITY.md). The only
-heavy import (jax, via utils.tracing) is deferred behind ``--trace``, so
-metrics-only reporting is instant.
+Reads all metrics schemas: v1 (pre-telemetry; accuracy/timing only), v2
+(``telemetry`` sub-object), v3 (``client_stats`` sub-object — see
+docs/OBSERVABILITY.md). The only heavy import (jax, via utils.tracing)
+is deferred behind ``--trace``, so metrics-only reporting is instant.
 """
 
 from __future__ import annotations
@@ -62,8 +67,64 @@ def load_metrics(path: str) -> list[dict]:
     return records
 
 
+def summarize_client_health(records: list[dict]) -> dict | None:
+    """Aggregate schema-v3 ``client_stats`` sub-objects into the
+    client-health summary: per-round flag table, the update-norm
+    divergence timeline, and per-client loss series when the records
+    carry raw per-client values. None when no record has client stats."""
+    cstats = [
+        (r.get("round"), r["client_stats"]) for r in records
+        if isinstance(r.get("client_stats"), dict)
+    ]
+    if not cstats:
+        return None
+    flagged_rounds = [
+        {
+            "round": rnd,
+            "flagged": cs.get("flagged_clients", []),
+            "reasons": cs.get("flag_reason", {}),
+        }
+        for rnd, cs in cstats if cs.get("flagged_clients")
+    ]
+    timeline = []
+    for rnd, cs in cstats:
+        un = (cs.get("quantiles") or {}).get("update_norm") or {}
+        timeline.append({
+            "round": rnd,
+            "update_norm_p50": un.get("p50"),
+            "update_norm_p100": un.get("p100"),
+            "flagged": len(cs.get("flagged_clients") or []),
+        })
+    per_client_loss: dict[str, list] = {}
+    for _, cs in cstats:
+        pc = cs.get("per_client")
+        if not pc:
+            continue
+        losses = pc.get("loss_after") or []
+        for cid, loss in zip(pc.get("client_ids", []), losses):
+            per_client_loss.setdefault(str(cid), []).append(loss)
+    health: dict = {
+        "rounds_reported": len(cstats),
+        "total_flags": sum(len(f["flagged"]) for f in flagged_rounds),
+        "flagged_rounds": flagged_rounds,
+        "divergence_timeline": timeline,
+    }
+    if per_client_loss:
+        health["per_client_loss"] = per_client_loss
+    for key in ("quant_mse", "vote_agreement"):
+        vals = [cs[key] for _, cs in cstats
+                if isinstance(cs.get(key), (int, float))]
+        if vals:
+            health[key] = {
+                "mean": round(statistics.mean(vals), 6),
+                "last": round(vals[-1], 6),
+            }
+    return health
+
+
 def summarize_run(records: list[dict], trace_stats: dict | None = None,
-                  top_ops: list[dict] | None = None) -> dict:
+                  top_ops: list[dict] | None = None,
+                  top_ops_time: list[dict] | None = None) -> dict:
     """Aggregate metrics records into the machine-readable summary the
     terminal renderer and ``--json`` output share."""
     if not records:
@@ -144,10 +205,16 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
                  if tel.get("peak_hbm_bytes")]
         summary["peak_hbm_bytes"] = max(peaks) if peaks else None
 
+    health = summarize_client_health(records)
+    if health is not None:
+        summary["client_health"] = health
+
     if trace_stats is not None:
         summary["trace"] = trace_stats
     if top_ops is not None:
         summary["top_device_ops"] = top_ops
+    if top_ops_time is not None:
+        summary["top_device_ops_time"] = top_ops_time
     return summary
 
 
@@ -210,15 +277,70 @@ def render_summary(summary: dict) -> list[str]:
     elif "phases" in summary:
         lines.append("peak HBM: unavailable on this backend")
 
+    if "client_health" in summary:
+        h = summary["client_health"]
+        lines.append(
+            f"client health: {h['rounds_reported']} round(s) with stats, "
+            f"{h['total_flags']} anomaly flag(s)"
+        )
+        for fr in h["flagged_rounds"]:
+            reasons = ", ".join(
+                f"{cid}:{reason}" for cid, reason in fr["reasons"].items()
+            )
+            lines.append(
+                f"  !! round {fr['round']}: flagged {fr['flagged']} "
+                f"({reasons})"
+            )
+        p100 = [
+            t["update_norm_p100"] for t in h["divergence_timeline"]
+            if t["update_norm_p100"] is not None
+        ]
+        if p100:
+            lines.append(
+                f"  divergence timeline (max update norm/round): "
+                f"{sparkline(p100)}  "
+                f"[{min(p100):.4g} .. {max(p100):.4g}]"
+            )
+        for key, label in (("quant_mse", "downlink quantization MSE"),
+                           ("vote_agreement", "vote agreement")):
+            if key in h:
+                lines.append(
+                    f"  {label}: mean {h[key]['mean']:.6g}, "
+                    f"last {h[key]['last']:.6g}"
+                )
+        loss_series = h.get("per_client_loss") or {}
+        if loss_series:
+            lines.append("  per-client local loss (round series):")
+            for cid in sorted(loss_series, key=int)[:16]:
+                series = [v for v in loss_series[cid] if v is not None]
+                last = f"{series[-1]:.4f}" if series else "n/a"
+                lines.append(
+                    f"    client {cid:>4}: {sparkline(series):<12} "
+                    f"last {last}"
+                )
+            if len(loss_series) > 16:
+                lines.append(
+                    f"    ... {len(loss_series) - 16} more client(s)"
+                )
+
     if "trace" in summary:
         t = summary["trace"]
         lines.append(
             f"device trace: {t['device_ms']:.1f} ms device time, "
             f"{t['bytes_gb']:.3f} GB accessed, {t['op_count']} ops"
         )
+    if summary.get("top_device_ops"):
+        lines.append("top device ops by bytes:")
     for op in summary.get("top_device_ops", []):
         lines.append(
             f"  {op['bytes_gb']:>8.3f} GB  {op['device_ms']:>8.2f} ms  "
+            f"x{op['count']:<5} {op['name']}"
+        )
+    if summary.get("top_device_ops_time"):
+        lines.append("top device ops by time:")
+    for op in summary.get("top_device_ops_time", []):
+        lines.append(
+            f"  {op['device_ms']:>8.2f} ms  {op['bytes_gb']:>8.3f} GB  "
             f"x{op['count']:<5} {op['name']}"
         )
     return lines
@@ -240,18 +362,20 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         records = load_metrics(args.artifacts)
-        trace_stats = top_ops = None
+        trace_stats = top_ops = top_ops_time = None
         if args.trace:
-            # Deferred: utils.tracing imports jax.
+            # Deferred: utils.tracing imports jax. One gzip pass serves
+            # the totals and both rankings.
             from distributed_learning_simulator_tpu.utils.tracing import (
-                parse_device_trace,
-                top_device_ops,
+                device_op_report,
             )
 
-            trace_stats = parse_device_trace(args.trace)
-            top_ops = top_device_ops(args.trace, k=args.top)
+            report = device_op_report(args.trace, k=args.top)
+            trace_stats = report["totals"]
+            top_ops = report["by_bytes"]
+            top_ops_time = report["by_time"]
         summary = summarize_run(records, trace_stats=trace_stats,
-                                top_ops=top_ops)
+                                top_ops=top_ops, top_ops_time=top_ops_time)
     except (FileNotFoundError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2

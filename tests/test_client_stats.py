@@ -1,0 +1,433 @@
+"""telemetry/client_stats.py: in-program per-client statistics, the
+median/MAD anomaly detector, and their wiring through every execution
+path (docs/OBSERVABILITY.md § Client statistics).
+
+Acceptance pins (ISSUE 4): client_stats='off' (the default) compiles an
+identical program — bit-identical accuracy history to 'on', zero
+post-warmup compiles, and byte-identical v2 metrics records; with
+corrupt_nan/corrupt_scale injection active the detector flags exactly
+the injected clients and stays silent on clean seeded runs (differential
+test reusing the PR 2 fault harness); the fused and materializing
+aggregation paths produce agreeing stats without the fused path ever
+holding the client stack.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.robustness.faults import FailureModel
+from distributed_learning_simulator_tpu.simulator import run_simulation
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    STAT_FIELDS,
+    ClientStats,
+    attribution_crosscheck,
+    client_stats_record,
+    detect_anomalies,
+)
+from distributed_learning_simulator_tpu.utils.reporting import config_hash
+
+_IDX = {name: i for i, name in enumerate(STAT_FIELDS)}
+
+
+def _stats(n, update_norm=1.0, loss_after=2.0, nonfinite=0.0):
+    s = np.zeros((n, len(STAT_FIELDS)))
+    s[:, _IDX["loss_before"]] = 2.5
+    s[:, _IDX["loss_after"]] = loss_after
+    s[:, _IDX["update_norm"]] = update_norm
+    s[:, _IDX["grad_norm"]] = 1.0
+    s[:, _IDX["agg_cosine"]] = 0.9
+    s[:, _IDX["nonfinite_count"]] = nonfinite
+    return s
+
+
+# --------------------------------------------------------------- detector
+
+
+def test_detector_single_client_never_z_flags():
+    """N=1: no population to compare against — only the non-finite rule
+    can fire."""
+    flagged, reasons = detect_anomalies(_stats(1, update_norm=1e9))
+    assert flagged == []
+    flagged, reasons = detect_anomalies(_stats(1, nonfinite=3.0))
+    assert flagged == [0] and reasons[0] == "non_finite"
+
+
+def test_detector_all_identical_updates_silent():
+    """MAD 0 from identical rows must not flag float-jitter: the z
+    denominator floors at a relative epsilon of the median."""
+    s = _stats(8)
+    s[3, _IDX["update_norm"]] += 1e-7  # float noise, not an anomaly
+    assert detect_anomalies(s) == ([], {})
+
+
+def test_detector_single_nan_client():
+    """One all-NaN upload among healthy peers: exactly that client,
+    reason non_finite — even though its norm/loss columns are NaN."""
+    s = _stats(8)
+    s[5, _IDX["nonfinite_count"]] = 1234.0
+    s[5, _IDX["update_norm"]] = np.nan
+    s[5, _IDX["loss_after"]] = np.nan
+    flagged, reasons = detect_anomalies(s)
+    assert flagged == [5] and reasons[5] == "non_finite"
+
+
+def test_detector_scaled_outlier_high_side_only():
+    """A 100x-norm upload is flagged via the robust z-score; a tiny-norm
+    client (an empty shard) is NOT an anomaly (high side only)."""
+    s = _stats(8)
+    s[2, _IDX["update_norm"]] *= 100.0
+    s[6, _IDX["update_norm"]] = 0.0
+    flagged, reasons = detect_anomalies(s)
+    assert flagged == [2] and reasons[2] == "update_norm"
+    diverged = _stats(8)
+    diverged[1, _IDX["loss_after"]] = 400.0
+    flagged, reasons = detect_anomalies(diverged)
+    assert flagged == [1] and reasons[1] == "loss_diverged"
+
+
+def test_detector_majority_empty_shards_silent():
+    """Empty-shard clients keep all-zero stats rows (the bucketed path's
+    design); a mostly-empty cohort must not collapse the median to 0 and
+    flag every honest client — zero-norm rows are excluded from the z
+    population AND the flaggable set."""
+    s = _stats(8)
+    for i in range(5):  # 5 empty shards, 3 honest clients
+        s[i] = 0.0
+    assert detect_anomalies(s) == ([], {})
+    # A genuine outlier among the active minority is still caught once
+    # enough active clients exist.
+    s = _stats(8)
+    for i in range(4):
+        s[i] = 0.0
+    s[7, _IDX["update_norm"]] *= 100.0
+    flagged, reasons = detect_anomalies(s)
+    assert flagged == [7] and reasons[7] == "update_norm"
+
+
+def test_detector_reasons_join():
+    s = _stats(8)
+    s[4, _IDX["nonfinite_count"]] = 1.0
+    s[4, _IDX["update_norm"]] *= 100.0
+    flagged, reasons = detect_anomalies(s)
+    assert flagged == [4]
+    assert set(reasons[4].split("+")) == {"non_finite", "update_norm"}
+
+
+def test_record_builder_quantiles_cap_and_sanitization():
+    s = _stats(4)
+    s[1, _IDX["loss_after"]] = np.nan
+    rec = client_stats_record(s, [1], {1: "non_finite"},
+                              participants=np.asarray([7, 5, 3, 1]),
+                              extras={"quant_mse": np.nan})
+    assert rec["n_clients"] == 4
+    assert rec["flagged_clients"] == [5]  # mapped through participants
+    assert rec["flag_reason"] == {"5": "non_finite"}
+    assert rec["per_client"]["client_ids"] == [7, 5, 3, 1]
+    assert rec["per_client"]["loss_after"][1] is None  # NaN -> null
+    assert rec["quant_mse"] is None  # extras sanitized too
+    assert rec["quantiles"]["update_norm"]["p50"] == 1.0
+    # Large cohorts: quantiles only, no per-client arrays.
+    big = client_stats_record(_stats(33), [], {})
+    assert "per_client" not in big and big["quantiles"]
+
+
+def test_attribution_crosscheck():
+    sv = np.asarray([0.1, 0.2, 0.3, 0.4])
+    s = _stats(4)
+    s[:, _IDX["loss_before"]] = 2.0 + sv  # improvement == sv
+    s[:, _IDX["loss_after"]] = 2.0
+    assert attribution_crosscheck(sv, s) == pytest.approx(1.0)
+    assert attribution_crosscheck(np.zeros(4), s) is None  # degenerate
+    assert attribution_crosscheck(sv[:1], s[:1]) is None  # too few
+
+
+# ------------------------------------------------------------ config knobs
+
+
+def test_from_config_and_validation(tiny_config):
+    assert ClientStats.from_config(tiny_config) is None
+    on = ClientStats.from_config(
+        dataclasses.replace(tiny_config, client_stats="on",
+                            client_stats_every=3)
+    )
+    assert on is not None and on.every == 3
+    assert on.fetch_round(0) and not on.fetch_round(2) and on.fetch_round(3)
+    with pytest.raises(ValueError, match="client_stats"):
+        dataclasses.replace(tiny_config, client_stats="loud").validate()
+    with pytest.raises(ValueError, match="client_stats_every"):
+        dataclasses.replace(
+            tiny_config, client_stats_every=0
+        ).validate()
+    # The knobs are program-defining: they land in the bench provenance
+    # hash (compare_bench's comparability refusal covers them).
+    h = config_hash(tiny_config)
+    assert config_hash(
+        dataclasses.replace(tiny_config, client_stats="on")
+    ) != h
+    assert config_hash(
+        dataclasses.replace(tiny_config, client_stats_probe=128)
+    ) != h
+    # The detector threshold is host-side only — tuning it must keep
+    # bench runs comparable.
+    assert config_hash(
+        dataclasses.replace(tiny_config, client_stats_mad_threshold=4.0)
+    ) == h
+
+
+# ------------------------------------------------------------- integration
+
+
+def _records(log_root):
+    metrics = glob.glob(
+        os.path.join(log_root, "**", "metrics.jsonl"), recursive=True
+    )
+    assert len(metrics) == 1
+    with open(metrics[0]) as f:
+        return [json.loads(line) for line in f]
+
+
+def _validate_schema(records):
+    import jsonschema
+
+    with open(os.path.join(os.path.dirname(__file__), "data",
+                           "metrics_record.schema.json")) as f:
+        schema = json.load(f)
+    for r in records:
+        jsonschema.validate(r, schema)
+
+
+def test_off_is_identical_program_and_v2_records(tiny_config, tmp_path):
+    """The acceptance pin: client_stats='off' + telemetry keeps the
+    byte-identical v2 record layout and zero post-warmup compiles, and
+    'on' trains BIT-identically (no RNG consumed, no math changed) while
+    upgrading records to v3 — with zero false positives on this clean
+    seeded run."""
+    cfg_off = dataclasses.replace(
+        tiny_config, round=3, telemetry_level="basic",
+        compilation_cache_dir=None, log_root=str(tmp_path / "off"),
+    )
+    assert cfg_off.client_stats == "off"
+    r_off = run_simulation(cfg_off)
+    off_records = _records(cfg_off.log_root)
+    assert r_off["post_warmup_compiles"] == 0
+    assert r_off["clients_flagged"] is None
+    for r in off_records:
+        assert r["schema_version"] == 2
+        assert "client_stats" not in r
+        assert set(r) == {
+            "round", "test_accuracy", "test_loss", "mean_client_loss",
+            "round_seconds", "schema_version", "telemetry",
+        }
+    _validate_schema(off_records)
+
+    cfg_on = dataclasses.replace(
+        cfg_off, client_stats="on", log_root=str(tmp_path / "on"),
+    )
+    r_on = run_simulation(cfg_on)
+    on_records = _records(cfg_on.log_root)
+    assert r_on["post_warmup_compiles"] == 0
+    assert r_on["clients_flagged"] == 0  # no false positives, clean run
+    # Identical program: the stats ride along without touching training.
+    assert [h["test_accuracy"] for h in r_on["history"]] == [
+        h["test_accuracy"] for h in r_off["history"]
+    ]
+    for r in on_records:
+        assert r["schema_version"] == 3
+        cs = r["client_stats"]
+        assert cs["flagged_clients"] == []
+        assert cs["n_clients"] == tiny_config.worker_number
+        assert set(cs["quantiles"]) == set(STAT_FIELDS)
+        assert cs["quantiles"]["nonfinite_count"]["p100"] == 0.0
+        assert cs["quantiles"]["update_norm"]["p0"] > 0.0
+        assert len(cs["per_client"]["loss_after"]) == cfg_on.worker_number
+    _validate_schema(on_records)
+
+
+def _injected_per_round(cfg, n, rounds):
+    """Replay the simulator's round-key chain (the same splits
+    fedavg.round_fn makes) to recover which clients the failure model
+    corrupted each round — the PR 2 fault harness as detection oracle."""
+    fm = FailureModel.from_config(cfg)
+    key = jax.random.key(cfg.seed + 1)
+    out = []
+    for _ in range(rounds):
+        key, round_key = jax.random.split(key)
+        fault_key = jax.random.split(round_key, 5)[4]
+        failed = np.asarray(fm.draw_failed(fault_key, n))
+        out.append(sorted(np.flatnonzero(failed).tolist()))
+    return out
+
+
+def test_detector_flags_exactly_injected_corrupt_nan(tiny_config):
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=3, client_stats="on",
+        failure_mode="corrupt_nan", failure_prob=0.4, min_survivors=1,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    injected = _injected_per_round(cfg, 8, 3)
+    assert any(injected), "seeded run must inject at least once"
+    for h, inj in zip(r["history"], injected):
+        cs = h["client_stats"]
+        assert cs["flagged_clients"] == inj
+        assert all(
+            "non_finite" in cs["flag_reason"][str(i)] for i in inj
+        )
+    assert r["clients_flagged"] == sum(len(i) for i in injected)
+
+
+def test_detector_flags_exactly_injected_corrupt_scale(tiny_config):
+    """Finite Byzantine garbage (x100 uploads): caught by the update-norm
+    z-score on every round with an honest majority (the detector's
+    documented assumption — shared with the robust aggregation rules)."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=3, client_stats="on",
+        failure_mode="corrupt_scale", failure_prob=0.3,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    injected = _injected_per_round(cfg, 8, 3)
+    checked = 0
+    for h, inj in zip(r["history"], injected):
+        if len(inj) > 4:  # poisoned median: out of the detector's contract
+            continue
+        checked += 1
+        assert h["client_stats"]["flagged_clients"] == inj
+        for i in inj:
+            assert h["client_stats"]["flag_reason"][str(i)] == "update_norm"
+    assert checked and any(injected)
+
+
+def test_fused_and_materializing_stats_agree(tiny_config):
+    """The fused path's streaming per-chunk stats must agree with the
+    materializing path's whole-stack stats (client_eval=True forces the
+    stack): same fault points, same stat definitions."""
+    base = dataclasses.replace(
+        tiny_config, round=2, client_stats="on", client_chunk_size=2,
+    )
+    fused = run_simulation(
+        dataclasses.replace(base, client_eval=False), setup_logging=False
+    )
+    mat = run_simulation(
+        dataclasses.replace(base, client_eval=True), setup_logging=False
+    )
+    for hf, hm in zip(fused["history"], mat["history"]):
+        pf, pm = (h["client_stats"]["per_client"] for h in (hf, hm))
+        assert pf["client_ids"] == pm["client_ids"]
+        for field in STAT_FIELDS:
+            np.testing.assert_allclose(
+                np.asarray(pf[field], dtype=np.float64),
+                np.asarray(pm[field], dtype=np.float64),
+                rtol=2e-4, atol=1e-6, err_msg=field,
+            )
+
+
+def test_bucketed_path_reports_stats(tiny_config):
+    """Size-aware scheduling (Dirichlet skew + chunking) scatters the
+    per-client stats back to original positions; empty clients keep
+    zero rows and are never flagged (high-side rules)."""
+    cfg = dataclasses.replace(
+        tiny_config, round=2, client_stats="on", client_chunk_size=2,
+        partition="dirichlet",
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    for h in r["history"]:
+        cs = h["client_stats"]
+        assert cs["flagged_clients"] == []
+        assert cs["n_clients"] == cfg.worker_number
+        assert cs["quantiles"]["nonfinite_count"]["p100"] == 0.0
+
+
+def test_cadence_and_participant_mapping(tiny_config):
+    """client_stats_every=2 fetches rounds 0 and 2 only (round 1 keeps
+    its un-upgraded record), and sampled cohorts report TRUE client ids
+    through aux['participants']."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=3, client_stats="on",
+        client_stats_every=2, participation_fraction=0.5,
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    h0, h1, h2 = r["history"]
+    assert "client_stats" in h0 and "client_stats" in h2
+    assert "client_stats" not in h1 and "schema_version" not in h1
+    for h in (h0, h2):
+        ids = h["client_stats"]["per_client"]["client_ids"]
+        assert h["client_stats"]["n_clients"] == 4
+        assert len(set(ids)) == 4 and set(ids) <= set(range(8))
+
+
+def test_sign_sgd_vote_agreement(tiny_config):
+    """sign_SGD exposes the per-step majority-vote agreement fraction as
+    a round statistic (0.5 = coin-flip directions, 1.0 = unanimous)."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="sign_SGD", learning_rate=0.01,
+        client_stats="on",
+    )
+    off = run_simulation(
+        dataclasses.replace(cfg, client_stats="off"), setup_logging=False
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    for h in r["history"]:
+        cs = h["client_stats"]
+        assert 0.5 <= cs["vote_agreement"] <= 1.0
+        assert "flagged_clients" not in cs  # no per-client deltas to score
+    # The agreement reduction is a pure read of the vote sum: identical
+    # training either way.
+    assert [h["test_accuracy"] for h in r["history"]] == [
+        h["test_accuracy"] for h in off["history"]
+    ]
+
+
+def test_fed_quant_quantization_mse(tiny_config):
+    """fed_quant reports the downlink quantization MSE — nonzero, small,
+    and consistent with 8-bit stochastic rounding."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="fed_quant", client_stats="on",
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    for h in r["history"]:
+        mse = h["client_stats"]["quant_mse"]
+        assert mse is not None and 0.0 < mse < 1e-3
+
+
+def test_shapley_attribution_crosscheck(tiny_config):
+    """The Shapley servers cross-check their utility attribution against
+    the in-round stats (SV vs local loss improvement correlation)."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="multiround_shapley_value",
+        client_stats="on",
+    )
+    r = run_simulation(cfg, setup_logging=False)
+    corrs = [h.get("sv_stats_corr") for h in r["history"]]
+    assert any(c is not None for c in corrs)
+    assert all(c is None or -1.0 <= c <= 1.0 for c in corrs)
+
+
+def test_threaded_client_stats(tmp_path):
+    """The threaded oracle reports stats off its rendezvous stack through
+    the same shared record builder: update-norm/cosine columns live,
+    loss columns null (its workers report no losses)."""
+    cfg = ExperimentConfig(
+        dataset_name="synthetic", model_name="mlp",
+        distributed_algorithm="fed", worker_number=2, round=2, epoch=1,
+        learning_rate=0.1, batch_size=32, n_train=128, n_test=64,
+        log_level="WARNING", dataset_args={"difficulty": 0.5},
+        execution_mode="threaded", client_stats="on",
+        compilation_cache_dir=None, log_root=str(tmp_path / "log"),
+    )
+    result = run_simulation(cfg)
+    assert result["clients_flagged"] == 0  # same contract as vmap
+    records = _records(cfg.log_root)
+    assert len(records) == 2
+    for r in records:
+        assert r["schema_version"] == 3
+        cs = r["client_stats"]
+        assert cs["quantiles"]["update_norm"]["p50"] > 0.0
+        assert cs["per_client"]["loss_after"] == [None, None]
+        assert cs["flagged_clients"] == []
+    _validate_schema(records)

@@ -86,6 +86,52 @@ def test_missing_metrics_are_skipped_not_flagged(cb):
     )
 
 
+def test_client_stats_overhead_not_relatively_tracked(cb):
+    """The overhead ratio is a near-zero noisy quantity: it must NOT be
+    in the relative-change TRACKED list (0.01 -> 0.02 would read as
+    +100%); only the absolute self-gate below judges it."""
+    old, new = _record(), _record()
+    old["client_stats"] = {"overhead_ratio": 0.01}
+    new["client_stats"] = {"overhead_ratio": 0.04}  # within the gate
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "client_stats" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_client_stats_overhead_self_gate(cb, tmp_path):
+    """The in-record gate fires on the NEW record alone: its own bench
+    run already measured the on-vs-off round-time ratio."""
+    assert cb.overhead_gate(_record(), 0.10) is None  # leg absent: skip
+    ok = _record(client_stats={"overhead_ratio": 0.04})
+    assert cb.overhead_gate(ok, 0.10) is None
+    bad = _record(client_stats={"overhead_ratio": 0.37})
+    entry = cb.overhead_gate(bad, 0.10)
+    assert entry and entry["new"] == 0.37
+
+    # CLI: the self-gate alone must exit 1 even when every cross-record
+    # metric is unchanged, and the threshold flag overrides.
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "client_stats.overhead_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--stats-overhead-threshold", "0.5"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_provenance_refusal(cb):
     old, new = _record(), _record()
     new["config_hash"] = "fedcba654321"

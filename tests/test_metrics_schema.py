@@ -1,0 +1,143 @@
+"""metrics.jsonl record layouts vs the checked-in JSON schema.
+
+Jax-free (imports only utils.reporting + jsonschema): the schema at
+tests/data/metrics_record.schema.json is the reviewable contract every
+emitter (vmap simulator, threaded oracle) writes through
+``build_round_record``. v1 (legacy), v2 (+telemetry) and v3
+(+client_stats) records must validate; records that mix versions and
+sub-objects inconsistently must not. The integration tests in
+test_client_stats.py validate REAL produced records against the same
+file.
+"""
+
+import json
+import os
+
+import jsonschema
+import pytest
+
+from distributed_learning_simulator_tpu.utils.reporting import (
+    METRICS_SCHEMA_VERSION,
+    build_round_record,
+)
+
+_SCHEMA_PATH = os.path.join(
+    os.path.dirname(__file__), "data", "metrics_record.schema.json"
+)
+
+
+def load_schema() -> dict:
+    with open(_SCHEMA_PATH) as f:
+        return json.load(f)
+
+
+def validate(record: dict) -> None:
+    jsonschema.validate(record, load_schema())
+
+
+def _base() -> dict:
+    return {
+        "round": 3,
+        "test_accuracy": 0.61,
+        "test_loss": 1.1,
+        "mean_client_loss": 1.2,
+        "round_seconds": 0.41,
+    }
+
+
+def _telemetry() -> dict:
+    return {
+        "phase_seconds": {"client_step": 0.31, "eval": 0.04,
+                          "host_sync": 0.05, "post_round": 0.0},
+        "compiles": 1,
+        "compiled": ["round_fn"],
+        "peak_hbm_bytes": 9126805504,
+    }
+
+
+def _client_stats() -> dict:
+    return {
+        "n_clients": 4,
+        "flagged_clients": [2],
+        "flag_reason": {"2": "non_finite+update_norm"},
+        "quantiles": {
+            "loss_before": {"p0": 2.1, "p25": 2.2, "p50": 2.3, "p75": 2.4,
+                            "p100": 2.5},
+            "update_norm": {"p0": 0.1, "p25": 0.2, "p50": 0.2, "p75": 0.3,
+                            "p100": None},
+        },
+        "per_client": {
+            "client_ids": [0, 1, 2, 3],
+            "loss_after": [2.0, 2.1, None, 2.2],
+            "update_norm": [0.1, 0.2, None, 0.3],
+        },
+        "quant_mse": 1e-06,
+    }
+
+
+def test_schema_file_is_valid_draft7():
+    jsonschema.Draft7Validator.check_schema(load_schema())
+
+
+def test_v1_record_validates():
+    record = build_round_record(_base(), None, None)
+    assert record is not None and "schema_version" not in record
+    validate(record)
+    # Algorithm extras (compression ratios, shapley dicts rendered as
+    # numbers by the host loop's filter) are allowed in every version.
+    validate({**_base(), "uplink_compression_ratio": 4.0,
+              "survivor_count": 7, "round_rejected": False})
+
+
+def test_v2_record_validates():
+    record = build_round_record(_base(), _telemetry())
+    assert record["schema_version"] == 2
+    validate(record)
+
+
+def test_v3_record_validates():
+    record = build_round_record(_base(), _telemetry(), _client_stats())
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 3
+    validate(record)
+    # client_stats without telemetry (telemetry_level='off') is still v3.
+    validate(build_round_record(_base(), None, _client_stats()))
+    # Round-scalar-only sub-object (sign_SGD's vote agreement).
+    validate(build_round_record(
+        _base(), None, {"n_clients": 4, "vote_agreement": 0.93}
+    ))
+
+
+def test_version_content_mismatches_rejected():
+    # v2 stamp carrying a client_stats sub-object: the builder never
+    # emits it, and the schema must refuse it too.
+    bad = build_round_record(_base(), _telemetry())
+    bad["client_stats"] = _client_stats()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v3 stamp without the client_stats sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 3
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unversioned record smuggling a telemetry sub-object.
+    bad = dict(_base())
+    bad["telemetry"] = _telemetry()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown keys inside the versioned sub-objects are schema breaks,
+    # not silent extensions.
+    bad = build_round_record(_base(), {**_telemetry(), "mystery": 1})
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    bad = build_round_record(
+        _base(), None, {**_client_stats(), "mystery": 1}
+    )
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+
+
+def test_missing_required_base_fields_rejected():
+    record = build_round_record(_base(), _telemetry())
+    del record["test_accuracy"]
+    with pytest.raises(jsonschema.ValidationError):
+        validate(record)

@@ -182,13 +182,19 @@ def test_build_round_record_off_is_identity():
 
 
 def test_build_round_record_v2_layout():
+    """A telemetry-only record stays at the v2 stamp byte-for-byte —
+    the v3 layout exists only when a client_stats sub-object is present
+    (tests/test_client_stats.py, tests/test_metrics_schema.py)."""
     base = {"round": 3, "test_accuracy": 0.5}
     tel = {"phase_seconds": {"eval": 0.1}, "compiles": 0}
     out = build_round_record(base, tel)
     assert out is not base and "telemetry" not in base
-    assert out["schema_version"] == METRICS_SCHEMA_VERSION
+    assert out["schema_version"] == 2
     assert out["telemetry"] == tel
     assert out["round"] == 3
+    v3 = build_round_record(base, tel, {"n_clients": 4})
+    assert v3["schema_version"] == METRICS_SCHEMA_VERSION == 3
+    assert v3["client_stats"] == {"n_clients": 4}
 
 
 def test_config_hash_tracks_program_knobs_only(tiny_config):
@@ -247,7 +253,8 @@ def test_simulator_telemetry_stable_run(tiny_config, tmp_path):
     assert result["post_warmup_compiles"] == 0
     assert result["telemetry_level"] == "basic"
     assert len(records) == 3
-    assert all(r["schema_version"] == METRICS_SCHEMA_VERSION for r in records)
+    # client_stats off (the default): telemetry-only records keep v2.
+    assert all(r["schema_version"] == 2 for r in records)
     warmup = records[0]["telemetry"]
     assert warmup["compiles"] > 0
     assert any("round_fn" in n for n in warmup["compiled"])
@@ -327,7 +334,7 @@ def test_threaded_telemetry_basic(tmp_path):
         records = [json.loads(line) for line in f]
     assert len(records) == 2
     for r in records:
-        assert r["schema_version"] == METRICS_SCHEMA_VERSION
+        assert r["schema_version"] == 2
         assert {"aggregate", "eval", "post_round"} <= set(
             r["telemetry"]["phase_seconds"]
         )

@@ -12,7 +12,10 @@ import gzip
 import json
 import os
 
+import pytest
+
 from distributed_learning_simulator_tpu.utils.tracing import (
+    device_op_report,
     iter_device_ops,
     parse_device_trace,
     top_device_ops,
@@ -127,3 +130,24 @@ def test_top_device_ops_ranks_by_bytes(tmp_path):
     assert top[0]["count"] == 2 and top[0]["bytes_gb"] == 2.0
     assert top_device_ops(str(tmp_path), k=1)[0]["name"] == "fusion.1"
     assert top_device_ops(str(tmp_path / "missing")) == []
+
+    # by="time": same aggregation, ranked on device time with bytes as
+    # tiebreaker — report_run's "where did the time go" table.
+    by_time = top_device_ops(str(tmp_path), k=10, by="time")
+    assert [t["name"] for t in by_time] == [
+        "copy.2", "zerobytes.a", "fusion.1", "zerobytes.b",
+    ]
+    with pytest.raises(ValueError, match="by"):
+        top_device_ops(str(tmp_path), by="flops")
+
+    # device_op_report: totals + both rankings from ONE gzip pass must
+    # match the single-purpose helpers (report_run consumes this).
+    report = device_op_report(str(tmp_path), k=10)
+    assert report["by_bytes"] == top
+    assert report["by_time"] == by_time
+    single = parse_device_trace(str(tmp_path))
+    assert report["totals"]["op_count"] == single["op_count"]
+    assert report["totals"]["bytes_gb"] == pytest.approx(single["bytes_gb"])
+    assert report["totals"]["device_ms"] == pytest.approx(
+        single["device_ms"]
+    )
