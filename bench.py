@@ -39,7 +39,11 @@ sub-object re-runs the headline program with ``client_stats='on'``
 — scripts/compare_bench.py gates it (--stats-overhead-threshold);
 BENCH_CLIENT_STATS=0 skips, BENCH_CLIENT_STATS_ROUNDS sets its length.
 The client-stats knobs land in ``config_hash`` like every other
-program-defining field.
+program-defining field. The ``round_batch`` sub-object sweeps
+``rounds_per_dispatch`` K in {1, BENCH_ROUND_BATCH_K} on the headline
+program and records the wall-based K-vs-1 ``amortization_ratio``
+(docs/PERFORMANCE.md § Round batching) — compare_bench.py gates it
+absolutely (--batch-amortization-threshold); BENCH_ROUND_BATCH=0 skips.
 """
 
 from __future__ import annotations
@@ -298,6 +302,56 @@ def main():
                 cr["round_ms"]["median"] / r["round_ms"]["median"] - 1.0, 4
             ),
             "clients_flagged": cs_result["clients_flagged"],
+        }
+
+    # Round batching (ISSUE 5, config.rounds_per_dispatch): the SAME
+    # headline program dispatched K rounds at a time, so the
+    # amortization_ratio is an apples-to-apples K-vs-1 rate ratio measured
+    # in one bench run on one machine. Rates are WALL-based over the
+    # steady rounds (clients * rounds / elapsed): within a dispatch the
+    # per-round wall lands on the dispatch's first record, so the K=1
+    # median would be meaningless against K>1 — the elapsed-time rate is
+    # the honest common unit. The first K rounds are dropped on both legs
+    # (the first dispatch carries the scan program's compile). Gated by
+    # scripts/compare_bench.py --batch-amortization-threshold as an
+    # in-record ABSOLUTE floor, same pattern as the client_stats overhead
+    # gate. rounds_per_dispatch lands in config_hash like every other
+    # program-defining knob, so K-batched and unbatched headline runs
+    # can never be silently diffed. BENCH_ROUND_BATCH=0 skips;
+    # BENCH_ROUND_BATCH_K / BENCH_ROUND_BATCH_ROUNDS set the sweep.
+    run_rbatch = (
+        os.environ.get("BENCH_ROUND_BATCH", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_rbatch:
+        rb_k = int(os.environ.get("BENCH_ROUND_BATCH_K", "8"))
+        rb_rounds = int(os.environ.get("BENCH_ROUND_BATCH_ROUNDS", "16"))
+        # Round UP to a multiple of K: a trailing remainder dispatch is a
+        # different scan program whose compile would land inside the
+        # measured window and deflate the ratio with pure compile time.
+        rb_rounds = -(-rb_rounds // rb_k) * rb_k
+        rb_rates = {}
+        for k_ in (1, rb_k):
+            rb_config = ExperimentConfig(
+                model_name=model, round=rb_rounds + k_,
+                client_chunk_size=chunk, local_compute_dtype=dtype,
+                rounds_per_dispatch=k_,
+                **failure_knobs, **common,
+            )
+            rb_times, _ = _run(
+                rb_config, dataset=dataset, client_data=client_data
+            )
+            steady = rb_times[k_:]
+            rb_rates[k_] = n_clients * len(steady) / sum(steady)
+        record["round_batch"] = {
+            "k": rb_k,
+            "rounds": rb_rounds,
+            "k1_rate": round(rb_rates[1], 2),
+            "k_rate": round(rb_rates[rb_k], 2),
+            # >= 1.0 means batching pays: K rounds per dispatch move at
+            # least as fast as one-round dispatches.
+            "amortization_ratio": round(rb_rates[rb_k] / rb_rates[1], 4),
         }
 
     # Converged-GTG round wall-clock at the north-star population (ISSUE 1:

@@ -86,6 +86,16 @@ class FedAvg(Algorithm):
             or self.config.aggregation.lower() != "mean"
         )
 
+    @property
+    def supports_round_batching(self) -> bool:
+        # Round batching (config.rounds_per_dispatch) scan-stacks every
+        # aux output over K rounds: keep_client_params would materialize
+        # K cohort-sized parameter stacks, and client_eval's post_round
+        # must evaluate each round's raw stack — per-round data a
+        # batched dispatch cannot provide. Robust aggregation rules are
+        # fine: their stack is transient inside each scan iteration.
+        return not (self.keep_client_params or self._client_eval_enabled)
+
     # jax-level template hooks, parity with fed_server.py:38-42 -------------
     def process_client_payload(self, client_params, key):
         """Per-client payload transform before aggregation (identity here;

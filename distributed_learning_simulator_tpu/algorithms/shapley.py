@@ -485,6 +485,10 @@ class MultiRoundShapley(FedAvg):
     name = "multiround_shapley_value"
     keep_client_params = True
     supports_round_pipelining = False  # post_round consumes round metrics
+    # Round batching would hand post_round dispatch-final params and a
+    # K-stacked aux['client_params']; SV attribution needs each round's
+    # stack + metrics synchronously (same reason pipelining is off).
+    supports_round_batching = False
 
     def __init__(self, config):
         super().__init__(config)
@@ -593,6 +597,7 @@ class GTGShapley(FedAvg):
     name = "GTG_shapley_value"
     keep_client_params = True
     supports_round_pipelining = False  # post_round consumes round metrics
+    supports_round_batching = False  # same: per-round stacks + metrics
 
     def __init__(self, config):
         super().__init__(config)

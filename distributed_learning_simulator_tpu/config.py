@@ -269,6 +269,24 @@ class ExperimentConfig:
     # disabled for algorithms whose post_round needs same-round metrics
     # (Shapley) and when per-client state must be checkpointed.
     pipeline_rounds: bool = True
+    # Fuse this many federated rounds — train + server-optimizer step +
+    # server eval + the per-round RNG split chain — into ONE jitted
+    # dispatch (parallel/engine.py make_batched_round_fn), with per-round
+    # metrics stacked on device and fetched in a single transfer per
+    # dispatch. Amortizes the per-round host dispatch/eval-launch/sync
+    # overhead the Python round loop cannot hide (~28% of the headline
+    # round; docs/PERFORMANCE.md § Round batching). 1 (default) keeps the
+    # exact pre-feature per-round dispatch path — trace-time gated like
+    # failure_mode/client_stats — and K>1 history is bit-identical to
+    # K=1 (the in-program RNG chain replays the host loop's split
+    # sequence). Dispatch size is clipped to the next checkpoint
+    # boundary, so checkpoint_every and SIGTERM finish-in-flight
+    # semantics keep working at batch granularity. Algorithms opt in via
+    # Algorithm.supports_round_batching (FedAvg family incl. fed_quant,
+    # sign_SGD; the Shapley algorithms refuse — their post_round must see
+    # every round). Phase timings/recompile attribution become
+    # per-dispatch when K>1 (docs/OBSERVABILITY.md).
+    rounds_per_dispatch: int = 1
     # --- telemetry (telemetry/; docs/OBSERVABILITY.md) ----------------------
     # "off" (default): zero instrumentation — metrics.jsonl keeps the
     # legacy v1 record layout byte-for-byte and the measured program is
@@ -477,6 +495,18 @@ class ExperimentConfig:
             raise ValueError(
                 f"unknown execution_mode {self.execution_mode!r}; known: "
                 "vmap, threaded"
+            )
+        if self.rounds_per_dispatch < 1:
+            raise ValueError("rounds_per_dispatch must be >= 1")
+        if (
+            self.rounds_per_dispatch > 1
+            and self.execution_mode.lower() == "threaded"
+        ):
+            # The thread-per-client oracle sequences rounds on the host by
+            # construction; there is no program to batch.
+            raise ValueError(
+                "rounds_per_dispatch > 1 requires the vmap execution mode "
+                "(the threaded oracle dispatches per round)"
             )
         if (
             self.shapley_eval_samples is not None

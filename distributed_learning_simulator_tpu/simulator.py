@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import signal
 import threading
 import time
 import zlib
+from collections.abc import Mapping
 from contextlib import ExitStack, contextmanager
 
 import jax
@@ -44,6 +46,7 @@ from distributed_learning_simulator_tpu.data.registry import Dataset, get_datase
 from distributed_learning_simulator_tpu.factory import get_algorithm
 from distributed_learning_simulator_tpu.models.registry import get_model, init_params
 from distributed_learning_simulator_tpu.parallel.engine import (
+    make_batched_round_fn,
     make_decoder,
     make_eval_fn,
     make_optimizer,
@@ -142,14 +145,64 @@ def _lr_factor(config, round_idx: int) -> float:
         return 1.0
     horizon = config.lr_schedule_rounds or config.round
     if s == "cosine":
-        import math
-
         progress = min(round_idx / max(horizon - 1, 1), 1.0)
         return config.lr_min_factor + (1.0 - config.lr_min_factor) * 0.5 * (
             1.0 + math.cos(math.pi * progress)
         )
     # "step" (validate() guarantees the name set)
     return config.lr_step_gamma ** (round_idx // config.lr_step_size)
+
+
+def lr_factors(config, start: int, k: int) -> np.ndarray:
+    """Schedule factors for rounds ``start .. start+k-1`` as one f32 vector.
+
+    The single source for BOTH dispatch shapes: the host loop's per-round
+    scalar is ``lr_factors(config, r, 1)[0]`` and the batched dispatch
+    (config.rounds_per_dispatch > 1) passes the whole vector as the scan
+    operand — same _lr_factor values through the same f32 cast, so the
+    two programs see bit-identical schedule operands.
+    """
+    return np.asarray(
+        [_lr_factor(config, start + i) for i in range(k)], dtype=np.float32
+    )
+
+
+class _StackedAuxRow(Mapping):
+    """Lazy per-round view of a batched dispatch's scan-stacked aux.
+
+    RoundContext.aux promises per-round device arrays, but no
+    batching-capable algorithm's post_round reads aux today — slicing
+    every stacked leaf eagerly would dispatch K x leaves tiny gather ops
+    per dispatch on exactly the host path round batching exists to
+    shrink. Leaves are sliced only on access."""
+
+    __slots__ = ("_aux_k", "_i")
+
+    def __init__(self, aux_k: dict, i: int):
+        self._aux_k = aux_k
+        self._i = i
+
+    def __getitem__(self, name):
+        return self._aux_k[name][self._i]
+
+    def __iter__(self):
+        return iter(self._aux_k)
+
+    def __len__(self):
+        return len(self._aux_k)
+
+
+def _algo_checkpoint_state(algorithm, metrics, server_state) -> dict:
+    """Assemble the checkpoint's ``algo_state`` dict — the ONE copy shared
+    by the round-loop checkpoint cadence, the batched-dispatch flush, and
+    the SIGTERM force-write path (the copies were one field away from
+    drifting)."""
+    algo_state = {"prev_metrics": metrics}
+    if hasattr(algorithm, "shapley_values"):
+        algo_state["shapley_values"] = algorithm.shapley_values
+    if server_state is not None:
+        algo_state["server_opt_state"] = jax.device_get(server_state)
+    return algo_state
 
 
 def _assert_client_stack_feasible(config, global_params, n_clients: int):
@@ -408,10 +461,29 @@ def run_simulation(
             f"algorithm {config.distributed_algorithm!r} does not support "
             "lr_schedule (its round program takes no lr_scale operand)"
         )
+    if config.rounds_per_dispatch > 1 and not getattr(
+        algorithm, "supports_round_batching", False
+    ):
+        # Same capability pattern as supports_round_pipelining, but a
+        # refusal rather than a silent fallback: the user asked for a
+        # different dispatch shape, and post_round hooks that must see
+        # every round (Shapley's data-dependent subset evaluation) cannot
+        # run inside one fused program.
+        raise ValueError(
+            f"algorithm {config.distributed_algorithm!r} does not support "
+            "rounds_per_dispatch > 1: its post_round must observe every "
+            "round (for the FedAvg family this includes client_eval=True "
+            "and keep_client_params — their aux/post_round consume "
+            "per-round parameter stacks); set rounds_per_dispatch=1"
+        )
 
-    evaluate = jax.jit(make_eval_fn(
+    # The raw eval fn is shared by the standalone jitted program (K=1
+    # dispatches) and the batched dispatch, which fuses it into the
+    # round scan (rounds_per_dispatch > 1).
+    eval_fn = make_eval_fn(
         model.apply, preprocess=eval_preprocess, name="server_eval"
-    ))
+    )
+    evaluate = jax.jit(eval_fn)
     algorithm.prepare(
         model.apply, make_eval_fn(model.apply, preprocess=eval_preprocess)
     )
@@ -438,6 +510,7 @@ def run_simulation(
     # Optional server-side optimizer (FedOpt; exceeds the reference): the
     # aggregate is post-processed by a jitted pseudo-gradient step.
     server_state = None
+    server_update_fn = None
     server_update_jit = None
     _server = algorithm.make_server_update()
     if (
@@ -618,8 +691,15 @@ def run_simulation(
     checkpointing = bool(
         config.checkpoint_dir and config.checkpoint_every and is_primary
     )
+    # Round batching (config.rounds_per_dispatch > 1): K rounds fuse into
+    # one scan dispatch with one metric fetch each; pipelining's
+    # deferred-fetch trick is subsumed (the dispatch itself overlaps the
+    # per-round fetches it absorbed), so the two modes don't compose.
+    K = config.rounds_per_dispatch
+    batched = K > 1
     pipelined = (
         config.pipeline_rounds
+        and not batched
         and algorithm.supports_round_pipelining
         and not (
             checkpointing
@@ -629,12 +709,18 @@ def run_simulation(
     if config.pipeline_rounds and not pipelined:
         # The user asked for pipelining; say out loud why it is off (each
         # deferred fetch otherwise silently costs a full host-link RTT).
-        reason = (
-            "the algorithm's post_round must see each round's metrics"
-            if not algorithm.supports_round_pipelining
-            else "checkpointing needs per-client/server-optimizer state "
-            "that round r+1's dispatch would donate away"
-        )
+        if batched:
+            reason = (
+                "rounds_per_dispatch > 1 already amortizes the fetch "
+                "(one device_get per dispatch)"
+            )
+        elif not algorithm.supports_round_pipelining:
+            reason = "the algorithm's post_round must see each round's metrics"
+        else:
+            reason = (
+                "checkpointing needs per-client/server-optimizer state "
+                "that round r+1's dispatch would donate away"
+            )
         logger.info("pipeline_rounds disabled: %s", reason)
     t_start = time.perf_counter()
     t_prev_done = t_start
@@ -658,8 +744,109 @@ def run_simulation(
     client_stats_cfg = ClientStats.from_config(config)
     telemetry["clients_flagged"] = 0
 
-    def finalize(p: dict) -> None:
+    def emit_record(round_idx, metrics, fetched_loss, fetched_tel, ctx,
+                    tel_rec_fn, phase_round=None):
+        """Build + persist ONE round's metrics record from already-fetched
+        host values: post_round hook, record assembly, quorum/cohort
+        telemetry accumulation, client-stats detection, history append +
+        metrics.jsonl line. The shared tail of the K=1 ``finalize`` and
+        the batched-dispatch ``flush_dispatch`` — one copy, so the record
+        layout (and its byte-identical-at-defaults guarantee) cannot
+        drift between dispatch shapes. ``tel_rec_fn`` builds the
+        telemetry sub-object lazily AFTER post_round (so host-side
+        compiles attribute to this round); ``phase_round`` is where
+        post_round phase time accumulates (the dispatch's last round
+        under batching, so the one telemetry record carries every
+        phase)."""
         nonlocal prev_metrics, t_prev_done
+        if phase_round is None:
+            phase_round = round_idx
+        with annotate("post_round"), phase_timer.phase(
+                phase_round, "post_round"):
+            extra = algorithm.post_round(ctx) or {}
+        now = time.perf_counter()
+        record = {
+            "round": round_idx,
+            "test_accuracy": metrics["accuracy"],
+            "test_loss": metrics["loss"],
+            "mean_client_loss": float(fetched_loss),
+            # Wall time between successive round completions: covers train +
+            # eval + metric fetch + host post_round (Shapley time included —
+            # it IS per-round server work). Sums to total wall time (within
+            # a batched dispatch the dispatch's wall lands on its first
+            # round; later rounds record only their host-side tail).
+            "round_seconds": now - t_prev_done,
+            **{
+                k: v for k, v in extra.items()
+                if isinstance(v, (int, float, dict))
+            },
+        }
+        if config.lr_schedule.lower() != "constant":
+            record["lr_factor"] = _lr_factor(config, round_idx)
+        if "survivor_count" in fetched_tel:
+            record["survivor_count"] = int(fetched_tel["survivor_count"])
+            telemetry["survivor_counts"].append(record["survivor_count"])
+        if "round_rejected" in fetched_tel:
+            record["round_rejected"] = bool(fetched_tel["round_rejected"])
+            if record["round_rejected"]:
+                telemetry["rounds_rejected"] += 1
+                logger.warning(
+                    "round %d REJECTED by quorum policy (survivors=%s, "
+                    "min_survivors=%d): previous global model retained",
+                    round_idx, record.get("survivor_count"),
+                    config.min_survivors,
+                )
+        if "participants" in fetched_tel:
+            # CRC of the sampled cohort: a compact per-round fingerprint
+            # that lets the resume-determinism tests assert the cohort
+            # sampling stream survives checkpoint/resume bit-exactly
+            # without bloating metrics.jsonl with index lists.
+            record["cohort_hash"] = zlib.crc32(
+                np.ascontiguousarray(
+                    fetched_tel["participants"], dtype=np.int64
+                ).tobytes()
+            )
+        t_prev_done = now
+        cs_rec = None
+        extras = {
+            k: float(fetched_tel[k])
+            for k in ("quant_mse", "vote_agreement")
+            if k in fetched_tel
+        }
+        if "client_stats" in fetched_tel:
+            cs_rec, n_flagged = detect_and_record(
+                fetched_tel["client_stats"], client_stats_cfg,
+                round_idx, logger=logger,
+                participants=fetched_tel.get("participants"),
+                extras=extras,
+            )
+            telemetry["clients_flagged"] += n_flagged
+        elif extras:
+            # Algorithms without per-client deltas (sign_SGD) report
+            # round scalars only; non-finite values become null like
+            # every other client-stats field (strict-JSON contract).
+            cs_rec = {
+                "n_clients": n_clients,
+                **{
+                    k: (v if np.isfinite(v) else None)
+                    for k, v in extras.items()
+                },
+            }
+        tel_rec = tel_rec_fn()
+        if tel_rec is not None or cs_rec is not None:
+            record = build_round_record(record, tel_rec, cs_rec)
+        history.append(record)
+        if metrics_path:
+            with open(metrics_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        logger.info(
+            "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
+            round_idx, metrics["accuracy"], metrics["loss"],
+            record["round_seconds"],
+        )
+        prev_metrics = metrics
+
+    def finalize(p: dict) -> None:
         tel_keys = [
             k for k in ("survivor_count", "round_rejected", "participants")
             if k in p["aux"]
@@ -701,78 +888,10 @@ def run_simulation(
             ctx.extra["client_stats_np"] = np.asarray(
                 fetched_tel["client_stats"]
             )
-        with annotate("post_round"), phase_timer.phase(
-                p["round_idx"], "post_round"):
-            extra = algorithm.post_round(ctx) or {}
-        now = time.perf_counter()
-        record = {
-            "round": p["round_idx"],
-            "test_accuracy": metrics["accuracy"],
-            "test_loss": metrics["loss"],
-            "mean_client_loss": float(fetched_loss),
-            # Wall time between successive round completions: covers train +
-            # eval + metric fetch + host post_round (Shapley time included —
-            # it IS per-round server work). Sums to total wall time.
-            "round_seconds": now - t_prev_done,
-            **{
-                k: v for k, v in extra.items()
-                if isinstance(v, (int, float, dict))
-            },
-        }
-        if config.lr_schedule.lower() != "constant":
-            record["lr_factor"] = _lr_factor(config, p["round_idx"])
-        if "survivor_count" in fetched_tel:
-            record["survivor_count"] = int(fetched_tel["survivor_count"])
-            telemetry["survivor_counts"].append(record["survivor_count"])
-        if "round_rejected" in fetched_tel:
-            record["round_rejected"] = bool(fetched_tel["round_rejected"])
-            if record["round_rejected"]:
-                telemetry["rounds_rejected"] += 1
-                logger.warning(
-                    "round %d REJECTED by quorum policy (survivors=%s, "
-                    "min_survivors=%d): previous global model retained",
-                    p["round_idx"], record.get("survivor_count"),
-                    config.min_survivors,
-                )
-        if "participants" in fetched_tel:
-            # CRC of the sampled cohort: a compact per-round fingerprint
-            # that lets the resume-determinism tests assert the cohort
-            # sampling stream survives checkpoint/resume bit-exactly
-            # without bloating metrics.jsonl with index lists.
-            record["cohort_hash"] = zlib.crc32(
-                np.ascontiguousarray(
-                    fetched_tel["participants"], dtype=np.int64
-                ).tobytes()
-            )
-        t_prev_done = now
-        cs_rec = None
-        if cs_keys:
-            extras = {
-                k: float(fetched_tel[k])
-                for k in ("quant_mse", "vote_agreement")
-                if k in fetched_tel
-            }
-            if "client_stats" in fetched_tel:
-                cs_rec, n_flagged = detect_and_record(
-                    fetched_tel["client_stats"], client_stats_cfg,
-                    p["round_idx"], logger=logger,
-                    participants=fetched_tel.get("participants"),
-                    extras=extras,
-                )
-                telemetry["clients_flagged"] += n_flagged
-            elif extras:
-                # Algorithms without per-client deltas (sign_SGD) report
-                # round scalars only; non-finite values become null like
-                # every other client-stats field (strict-JSON contract).
-                cs_rec = {
-                    "n_clients": n_clients,
-                    **{
-                        k: (v if np.isfinite(v) else None)
-                        for k, v in extras.items()
-                    },
-                }
-        tel_rec = None
-        if phase_timer.enabled:
+
+        def tel_rec_fn():
+            if not phase_timer.enabled:
+                return None
             # Attribute post_round/host-side compiles, then fold this
             # round's telemetry into a schema-v2/v3 record (shared
             # builder: utils/reporting.py). Warmup = the first EXECUTED
@@ -800,36 +919,24 @@ def run_simulation(
             peak = peak_hbm_bytes()
             if peak is not None:
                 tel_rec["peak_hbm_bytes"] = peak
-        if tel_rec is not None or cs_rec is not None:
-            record = build_round_record(record, tel_rec, cs_rec)
-        history.append(record)
-        if metrics_path:
-            with open(metrics_path, "a") as f:
-                f.write(json.dumps(record) + "\n")
-        logger.info(
-            "round %d: test_acc=%.4f test_loss=%.4f (%.2fs)",
-            p["round_idx"], metrics["accuracy"], metrics["loss"],
-            record["round_seconds"],
+            return tel_rec
+
+        emit_record(
+            p["round_idx"], metrics, fetched_loss, fetched_tel, ctx,
+            tel_rec_fn,
         )
-        prev_metrics = metrics
 
         if (
             checkpointing
             and (p["round_idx"] + 1) % config.checkpoint_every == 0
         ):
-            algo_state = {"prev_metrics": metrics}
-            if hasattr(algorithm, "shapley_values"):
-                algo_state["shapley_values"] = algorithm.shapley_values
-            if p["server_state"] is not None:
-                algo_state["server_opt_state"] = jax.device_get(
-                    p["server_state"]
-                )
             save_checkpoint(
                 os.path.join(
                     config.checkpoint_dir, f"round_{p['round_idx']}.ckpt"
                 ),
                 p["round_idx"], p["new_global"], p["client_state"],
-                algo_state, p["key"],
+                _algo_checkpoint_state(algorithm, metrics, p["server_state"]),
+                p["key"],
             )
             gc_checkpoints(config.checkpoint_dir, config.checkpoint_keep_last)
         # Chaos-harness hook (robustness/chaos.py): inert unless
@@ -837,6 +944,127 @@ def run_simulation(
         # an injected crash models "the process died right after round N
         # was persisted".
         maybe_crash(p["round_idx"])
+
+    # Dispatch sizes already compiled this run (rounds_per_dispatch > 1):
+    # a size seen for the first time (remainder/checkpoint-clipped
+    # dispatches) legitimately compiles its own scan program — logged as
+    # warmup, not as the shape-instability warning.
+    seen_dispatch_sizes: set[int] = set()
+
+    def flush_dispatch(d: dict) -> None:
+        """Record a whole batched dispatch (rounds_per_dispatch > 1): ONE
+        device_get for the stacked per-round metrics/telemetry, then one
+        emit_record per round. Phase timings and recompile attribution
+        are per-DISPATCH, attached to the dispatch's LAST round's record
+        (the only one whose post_round has already run when its record is
+        written; docs/OBSERVABILITY.md)."""
+        first, k = d["round_start"], d["k"]
+        last = first + k - 1
+        rounds = range(first, last + 1)
+        aux_k = d["aux"]
+        tel_keys = [
+            name for name in
+            ("survivor_count", "round_rejected", "participants")
+            if name in aux_k
+        ]
+        # Client-stats cadence at batch granularity: the stacked rows ride
+        # the dispatch's single device_get; records carry them only for
+        # rounds on the client_stats_every cadence (matching K=1).
+        fetch_rounds = {
+            r for r in rounds
+            if client_stats_cfg is not None
+            and client_stats_cfg.fetch_round(r)
+        }
+        cs_keys = [
+            name for name in ("client_stats", "quant_mse", "vote_agreement")
+            if name in aux_k
+        ] if fetch_rounds else []
+        with phase_timer.phase(last, "host_sync"), _oom_hint(
+                config, d["new_global"], n_clients,
+                site="deferred metric fetch"):
+            fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
+                (d["metrics"], d["mean_loss"],
+                 {name: aux_k[name] for name in tel_keys + cs_keys})
+            )
+
+        def tel_rec_fn():
+            if not phase_timer.enabled:
+                return None
+            recompile.attribute(last)
+            events = recompile.take(last)
+            warm = first == start_round or k not in seen_dispatch_sizes
+            seen_dispatch_sizes.add(k)
+            n_compiles = log_round_compiles(logger, last, events, warmup=warm)
+            if not warm:
+                post_warmup_compiles["count"] += n_compiles
+            tel_rec = {
+                "phase_seconds": {
+                    name: round(v, 6)
+                    for name, v in sorted(phase_timer.take(last).items())
+                },
+                "compiles": n_compiles,
+                # Tells consumers (scripts/report_run.py) the phase times
+                # and compile counts cover this many rounds — render
+                # per-dispatch, never double-count.
+                "dispatch_rounds": k,
+            }
+            if warm and n_compiles:
+                # First dispatch of this length: its compiles are
+                # expected, so offline reporting must not count them as
+                # post-warmup shape instability.
+                tel_rec["warmup"] = True
+            if events:
+                tel_rec["compiled"] = [name for name, _ in events]
+            peak = peak_hbm_bytes()
+            if peak is not None:
+                tel_rec["peak_hbm_bytes"] = peak
+            return tel_rec
+
+        for i, round_idx in enumerate(rounds):
+            metrics = {
+                name: float(v[i]) for name, v in fetched_metrics.items()
+            }
+            row_keys = tel_keys + (
+                cs_keys if round_idx in fetch_rounds else []
+            )
+            tel_row = {name: fetched_tel[name][i] for name in row_keys}
+            ctx = RoundContext(
+                round_idx=round_idx,
+                # Dispatch-granular params — the supports_round_batching
+                # contract: post_round sees the dispatch-FINAL model and
+                # the dispatch-initial previous one.
+                global_params=d["new_global"],
+                prev_global_params=d["prev_global"],
+                sizes=sizes,
+                aux=_StackedAuxRow(aux_k, i),
+                metrics=metrics,
+                prev_metrics=prev_metrics,
+                eval_batches=eval_batches,
+                log_dir=log_dir,
+            )
+            if "client_stats" in tel_row:
+                ctx.extra["client_stats_np"] = np.asarray(
+                    tel_row["client_stats"]
+                )
+            emit_record(
+                round_idx, metrics, fetched_loss[i], tel_row, ctx,
+                tel_rec_fn if round_idx == last else (lambda: None),
+                phase_round=last,
+            )
+        # Dispatch sizes are clipped to checkpoint boundaries, so the
+        # cadence only ever fires on the dispatch's last round — where
+        # the carried client/server/RNG state is exactly that round's.
+        if checkpointing and (last + 1) % config.checkpoint_every == 0:
+            save_checkpoint(
+                os.path.join(config.checkpoint_dir, f"round_{last}.ckpt"),
+                last, d["new_global"], d["client_state"],
+                _algo_checkpoint_state(
+                    algorithm, prev_metrics, d["server_state"]
+                ),
+                d["key"],
+            )
+            gc_checkpoints(config.checkpoint_dir, config.checkpoint_keep_last)
+        maybe_crash(last)
 
     profile_from = getattr(config, "profile_from_round", 0)
     # SIGTERM grace hook (TPU preemption notice, docs/ROBUSTNESS.md): the
@@ -872,95 +1100,195 @@ def run_simulation(
         # the deferred round that already completed on device still gets its
         # metrics line and checkpoint written before unwinding.
         try:
-            for round_idx in range(start_round, config.round):
-                if (
-                    config.profile_dir
-                    and profile_from is not None
-                    and round_idx >= profile_from
-                ):
-                    # Deferred trace start (config.profile_from_round):
-                    # round 0's XLA compile floods the tunnel profiler's
-                    # event buffer and device events get dropped —
-                    # measured: whole-loop flagship traces come back
-                    # empty or truncated at a run-varying point, while a
-                    # steady-state round traced after compile captures
-                    # fully (scripts/profile_sign_round.py's method).
-                    profile_stack.enter_context(
-                        profile_session(config.profile_dir)
-                    )
-                    profile_from = None
-                key, round_key = jax.random.split(key)
-                with annotate(f"fl_round_{round_idx}"), _oom_hint(
-                    config, global_params, n_clients
-                ):
-                    # The schedule factor is a traced operand only when a
-                    # schedule is active; the constant default uses the
-                    # round_fn's Python default 1.0, which constant-folds
-                    # at trace time (no per-step scale multiply in the
-                    # compiled program).
-                    lr_args = () if config.lr_schedule.lower() == (
-                        "constant"
-                    ) else (jnp.float32(_lr_factor(config, round_idx)),)
-                    with phase_timer.phase(round_idx, "client_step") as _ph:
-                        new_global, client_state, aux = round_jit(
-                            global_params, client_state, cx, cy, cmask, sizes,
-                            round_key, *lr_args,
+            if batched:
+                # Batched dispatches (rounds_per_dispatch > 1): the host
+                # loop walks batch boundaries instead of rounds. Dispatch
+                # size = min(K, rounds remaining, distance to the next
+                # checkpoint boundary), so checkpoint_every and SIGTERM
+                # finish-in-flight semantics keep working at batch
+                # granularity; each distinct size compiles its own scan
+                # program once (cached below — a remainder dispatch is a
+                # different program, counted as warmup, not instability).
+                batched_jits: dict[int, object] = {}
+                lr_active = config.lr_schedule.lower() != "constant"
+                round_idx = start_round
+                while round_idx < config.round:
+                    k = min(K, config.round - round_idx)
+                    # Clip from the CONFIG, not `checkpointing` (which is
+                    # primary-gated): under multihost SPMD every process
+                    # must choose the same dispatch length or they run
+                    # different scan programs and the collectives desync.
+                    # Only the checkpoint WRITE is primary-only.
+                    if config.checkpoint_dir and config.checkpoint_every:
+                        k = min(
+                            k,
+                            config.checkpoint_every
+                            - (round_idx % config.checkpoint_every),
                         )
-                        _ph.fence((new_global, aux))
-                    if server_update_jit is not None:
-                        # When the round program carries a quorum verdict,
-                        # the server optimizer must see it: a rejected
-                        # round freezes the optimizer state and leaves the
-                        # params untouched (momentum alone would otherwise
-                        # move the "retained" model).
-                        srv_args = (global_params, new_global, server_state)
-                        if "round_rejected" in aux:
-                            srv_args += (aux["round_rejected"],)
+                    last_idx = round_idx + k - 1
+                    if (
+                        config.profile_dir
+                        and profile_from is not None
+                        and round_idx >= profile_from
+                    ):
+                        # Deferred trace start at dispatch granularity
+                        # (rationale: the K=1 loop below).
+                        profile_stack.enter_context(
+                            profile_session(config.profile_dir)
+                        )
+                        profile_from = None
+                    dispatch = batched_jits.get(k)
+                    if dispatch is None:
+                        dispatch = jax.jit(
+                            make_batched_round_fn(
+                                round_fn, server_update_fn, eval_fn, k,
+                                lr_active,
+                            ),
+                            donate_argnums=(1, 2),
+                        )
+                        batched_jits[k] = dispatch
+                    # The schedule factors become a length-k f32 operand
+                    # vector (lr_factors — same values, same cast as the
+                    # K=1 scalar operand); the constant default is
+                    # omitted so it constant-folds exactly like the
+                    # unbatched program.
+                    lr_args = (
+                        (jnp.asarray(lr_factors(config, round_idx, k)),)
+                        if lr_active else ()
+                    )
+                    prev_global = global_params
+                    with annotate(
+                        f"fl_rounds_{round_idx}_{last_idx}"
+                    ), _oom_hint(config, global_params, n_clients):
                         with phase_timer.phase(
-                                round_idx, "aggregate") as _ph:
-                            new_global, server_state = server_update_jit(
-                                *srv_args
+                                last_idx, "client_step") as _ph:
+                            (
+                                global_params, client_state, server_state,
+                                key, metrics_k, aux_k,
+                            ) = dispatch(
+                                global_params, client_state, server_state,
+                                key, cx, cy, cmask, sizes, eval_batches,
+                                *lr_args,
                             )
-                            _ph.fence(new_global)
-                with annotate("server_eval"), _oom_hint(
-                    config, global_params, n_clients, site="eval"
-                ):
-                    with phase_timer.phase(round_idx, "eval") as _ph:
-                        metrics_dev = evaluate(new_global, *eval_batches)
-                        _ph.fence(metrics_dev)
-                if recompile is not None:
-                    # Compiles are synchronous with trace/lower, so events
-                    # pending here came from this round's dispatches
-                    # (under pipelining, the deferred finalize of round
-                    # r-1 runs after this and must not absorb them).
-                    recompile.attribute(round_idx)
-                entry = {
-                    "round_idx": round_idx,
-                    "new_global": new_global,
-                    "prev_global": global_params,
-                    "client_state": None if pipelined else client_state,
-                    "aux": aux,
-                    "metrics_dev": metrics_dev,
-                    "mean_loss_dev": aux.get("mean_client_loss", np.nan),
-                    "key": key,
-                    "server_state": server_state,
-                }
-                global_params = new_global
-                if pipelined:
-                    # Take ownership of `entry` before finalizing the prior
-                    # round: if that finalize raises, the finally block still
-                    # records this round (the raising round is what's lost).
-                    prev_pending, pending = pending, entry
-                    if prev_pending is not None:
-                        finalize(prev_pending)
-                else:
-                    finalize(entry)
-                completed_round = round_idx
-                if preempt["flag"]:
-                    # Finish-in-flight semantics: this round completed (and
-                    # with pipelining its deferred finalize runs in the
-                    # crash-flush below); no new round is dispatched.
-                    break
+                            _ph.fence((global_params, metrics_k))
+                    if recompile is not None:
+                        recompile.attribute(last_idx)
+                    mean_loss_k = aux_k.get("mean_client_loss")
+                    if mean_loss_k is None:
+                        mean_loss_k = np.full(k, np.nan)
+                    flush_dispatch({
+                        "round_start": round_idx,
+                        "k": k,
+                        "metrics": metrics_k,
+                        "mean_loss": mean_loss_k,
+                        "aux": aux_k,
+                        "new_global": global_params,
+                        "prev_global": prev_global,
+                        "client_state": client_state,
+                        "server_state": server_state,
+                        "key": key,
+                    })
+                    completed_round = last_idx
+                    round_idx = last_idx + 1
+                    if preempt["flag"]:
+                        # Finish-in-flight at batch granularity: the
+                        # dispatched rounds completed and were recorded;
+                        # no new dispatch is launched.
+                        break
+            else:
+                for round_idx in range(start_round, config.round):
+                    if (
+                        config.profile_dir
+                        and profile_from is not None
+                        and round_idx >= profile_from
+                    ):
+                        # Deferred trace start (config.profile_from_round):
+                        # round 0's XLA compile floods the tunnel profiler's
+                        # event buffer and device events get dropped —
+                        # measured: whole-loop flagship traces come back
+                        # empty or truncated at a run-varying point, while a
+                        # steady-state round traced after compile captures
+                        # fully (scripts/profile_sign_round.py's method).
+                        profile_stack.enter_context(
+                            profile_session(config.profile_dir)
+                        )
+                        profile_from = None
+                    key, round_key = jax.random.split(key)
+                    with annotate(f"fl_round_{round_idx}"), _oom_hint(
+                        config, global_params, n_clients
+                    ):
+                        # The schedule factor is a traced operand only when a
+                        # schedule is active; the constant default uses the
+                        # round_fn's Python default 1.0, which constant-folds
+                        # at trace time (no per-step scale multiply in the
+                        # compiled program). lr_factors is the one
+                        # formula shared with the batched dispatch's
+                        # operand vector.
+                        lr_args = () if config.lr_schedule.lower() == (
+                            "constant"
+                        ) else (
+                            jnp.float32(lr_factors(config, round_idx, 1)[0]),
+                        )
+                        with phase_timer.phase(round_idx, "client_step") as _ph:
+                            new_global, client_state, aux = round_jit(
+                                global_params, client_state, cx, cy, cmask, sizes,
+                                round_key, *lr_args,
+                            )
+                            _ph.fence((new_global, aux))
+                        if server_update_jit is not None:
+                            # When the round program carries a quorum verdict,
+                            # the server optimizer must see it: a rejected
+                            # round freezes the optimizer state and leaves the
+                            # params untouched (momentum alone would otherwise
+                            # move the "retained" model).
+                            srv_args = (global_params, new_global, server_state)
+                            if "round_rejected" in aux:
+                                srv_args += (aux["round_rejected"],)
+                            with phase_timer.phase(
+                                    round_idx, "aggregate") as _ph:
+                                new_global, server_state = server_update_jit(
+                                    *srv_args
+                                )
+                                _ph.fence(new_global)
+                    with annotate("server_eval"), _oom_hint(
+                        config, global_params, n_clients, site="eval"
+                    ):
+                        with phase_timer.phase(round_idx, "eval") as _ph:
+                            metrics_dev = evaluate(new_global, *eval_batches)
+                            _ph.fence(metrics_dev)
+                    if recompile is not None:
+                        # Compiles are synchronous with trace/lower, so events
+                        # pending here came from this round's dispatches
+                        # (under pipelining, the deferred finalize of round
+                        # r-1 runs after this and must not absorb them).
+                        recompile.attribute(round_idx)
+                    entry = {
+                        "round_idx": round_idx,
+                        "new_global": new_global,
+                        "prev_global": global_params,
+                        "client_state": None if pipelined else client_state,
+                        "aux": aux,
+                        "metrics_dev": metrics_dev,
+                        "mean_loss_dev": aux.get("mean_client_loss", np.nan),
+                        "key": key,
+                        "server_state": server_state,
+                    }
+                    global_params = new_global
+                    if pipelined:
+                        # Take ownership of `entry` before finalizing the prior
+                        # round: if that finalize raises, the finally block still
+                        # records this round (the raising round is what's lost).
+                        prev_pending, pending = pending, entry
+                        if prev_pending is not None:
+                            finalize(prev_pending)
+                    else:
+                        finalize(entry)
+                    completed_round = round_idx
+                    if preempt["flag"]:
+                        # Finish-in-flight semantics: this round completed (and
+                        # with pipelining its deferred finalize runs in the
+                        # crash-flush below); no new round is dispatched.
+                        break
         finally:
             if sigterm_installed:
                 signal.signal(signal.SIGTERM, prev_sigterm)
@@ -992,16 +1320,13 @@ def run_simulation(
                 config.checkpoint_dir, f"round_{completed_round}.ckpt"
             )
             if not os.path.exists(forced_path):
-                algo_state = {"prev_metrics": prev_metrics}
-                if hasattr(algorithm, "shapley_values"):
-                    algo_state["shapley_values"] = algorithm.shapley_values
-                if server_state is not None:
-                    algo_state["server_opt_state"] = jax.device_get(
-                        server_state
-                    )
                 save_checkpoint(
                     forced_path, completed_round, global_params,
-                    client_state, algo_state, key,
+                    client_state,
+                    _algo_checkpoint_state(
+                        algorithm, prev_metrics, server_state
+                    ),
+                    key,
                 )
                 gc_checkpoints(
                     config.checkpoint_dir, config.checkpoint_keep_last

@@ -23,14 +23,18 @@ headline/flagship rates (higher is better), converged-GTG round seconds
 byte-exact program properties, so ANY growth beyond the threshold is a
 real program change), rejected-round and survivor robustness counters.
 
-One in-record gate runs on the NEW record alone: its ``client_stats``
+Two in-record gates run on the NEW record alone: its ``client_stats``
 sub-object already holds the on-vs-off round-time overhead measured
 within that single bench run (bench.py re-runs the headline program
 with client_stats='on'), so an overhead above
 ``--stats-overhead-threshold`` is a regression regardless of the old
 record — the feature's promise is "cheap enough to leave on". The
 ratio is judged ABSOLUTELY, never as a tracked relative metric: it
-hovers near zero, where relative changes are pure noise.
+hovers near zero, where relative changes are pure noise. The
+``round_batch`` leg's ``amortization_ratio`` (rounds_per_dispatch
+K-vs-1 rate ratio, measured within the run) gets the same treatment:
+``--batch-amortization-threshold`` is an absolute floor — it hovers
+near 1.0, where a relative gate would flap.
 
 Deliberately imports nothing heavy (no jax): usable as a CI gate and
 fast enough to self-test in tier-1 (tests/test_compare_bench.py).
@@ -146,6 +150,28 @@ def overhead_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def batch_amortization_gate(record: dict, threshold: float) -> dict | None:
+    """In-record round-batching gate: bench.py's ``round_batch`` leg
+    measures the K-vs-1 rate ratio of ``rounds_per_dispatch`` within one
+    run, so a ratio below ``threshold`` means batching stopped paying for
+    itself — a regression regardless of the old record. Judged
+    ABSOLUTELY (like the client-stats overhead): the ratio hovers near
+    1.0, where a relative-change gate would flap. None when the leg is
+    absent or the ratio holds."""
+    ratio = get_path(record, "round_batch.amortization_ratio")
+    if ratio is None or ratio >= threshold:
+        return None
+    return {
+        "metric": "round_batch.amortization_ratio",
+        "description": (
+            "rounds_per_dispatch=K vs K=1 rate ratio from the same "
+            "bench run (>= 1.0 means batching pays)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def _fmt(entry: dict) -> str:
     rel = entry["relative_change"]
     rel_s = f"{rel:+.1%}" if rel is not None else "n/a"
@@ -169,6 +195,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--stats-overhead-threshold", type=float, default=0.10,
                     help="max tolerated client_stats=on round-time overhead "
                          "ratio in the NEW record (default 0.10)")
+    ap.add_argument("--batch-amortization-threshold", type=float,
+                    default=0.95,
+                    help="min tolerated rounds_per_dispatch K-vs-1 rate "
+                         "ratio in the NEW record's round_batch leg "
+                         "(default 0.95 — batching must at least break "
+                         "even, modulo run noise)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable comparison as JSON")
     args = ap.parse_args(argv)
@@ -190,9 +222,12 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     result = compare_records(old, new, threshold=args.threshold)
-    gate = overhead_gate(new, args.stats_overhead_threshold)
-    if gate is not None:
-        result["regressions"].append(gate)
+    for gate in (
+        overhead_gate(new, args.stats_overhead_threshold),
+        batch_amortization_gate(new, args.batch_amortization_threshold),
+    ):
+        if gate is not None:
+            result["regressions"].append(gate)
     if args.json:
         print(json.dumps(result, indent=2))
     else:

@@ -95,6 +95,15 @@ def test_v2_record_validates():
     validate(record)
 
 
+def test_v2_batched_dispatch_record_validates():
+    """A batched dispatch's telemetry (rounds_per_dispatch > 1) adds
+    dispatch_rounds + the warmup marker; still plain v2."""
+    tel = {**_telemetry(), "dispatch_rounds": 8, "warmup": True}
+    record = build_round_record(_base(), tel)
+    assert record["schema_version"] == 2
+    validate(record)
+
+
 def test_v3_record_validates():
     record = build_round_record(_base(), _telemetry(), _client_stats())
     assert record["schema_version"] == METRICS_SCHEMA_VERSION == 3

@@ -1,0 +1,262 @@
+"""Round batching (config.rounds_per_dispatch; parallel/engine.py
+make_batched_round_fn): K>1 fuses K federated rounds + server eval into
+one dispatched scan whose history must be BIT-identical to the K=1
+per-round loop — including participation sampling, failure draws, quorum
+verdicts, lr-schedule factors, and server-optimizer state — while K=1
+(the default) keeps the exact pre-feature per-round program. Checkpoint
+cadence clips dispatch sizes, so resume composes at batch granularity.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.simulator import (
+    lr_factors,
+    run_simulation,
+)
+
+
+def _run(cfg, **overrides):
+    cfg = dataclasses.replace(cfg, **overrides)
+    return run_simulation(cfg, setup_logging=False)
+
+
+def _series(result, *keys):
+    return {k: [h.get(k) for h in result["history"]] for k in keys}
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="rounds_per_dispatch"):
+        ExperimentConfig(rounds_per_dispatch=0).validate()
+    with pytest.raises(ValueError, match="vmap execution mode"):
+        ExperimentConfig(
+            rounds_per_dispatch=2, execution_mode="threaded"
+        ).validate()
+    ExperimentConfig(rounds_per_dispatch=8).validate()
+
+
+def test_default_is_one():
+    assert ExperimentConfig().rounds_per_dispatch == 1
+
+
+def test_shapley_refuses_round_batching(tiny_config):
+    """Shapley's post_round must see every round's stack + metrics
+    synchronously; the simulator refuses with the cause, before any
+    training dispatch."""
+    with pytest.raises(ValueError, match="rounds_per_dispatch"):
+        _run(tiny_config, distributed_algorithm="GTG_shapley_value",
+             rounds_per_dispatch=2)
+
+
+def test_fed_quant_client_eval_gates_batching(tiny_config):
+    """fed_quant auto-enables client_eval at reference-like cohorts, whose
+    post_round needs each round's raw stack — batching is refused unless
+    client_eval is explicitly off (then the capability comes back)."""
+    from distributed_learning_simulator_tpu.factory import get_algorithm
+
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="fed_quant", rounds_per_dispatch=2
+    )
+    assert not get_algorithm("fed_quant", cfg).supports_round_batching
+    with pytest.raises(ValueError, match="rounds_per_dispatch"):
+        run_simulation(cfg, setup_logging=False)
+    opted_out = dataclasses.replace(cfg, client_eval=False)
+    assert get_algorithm("fed_quant", opted_out).supports_round_batching
+
+
+def test_lr_factors_vector_matches_scalar(tiny_config):
+    cfg = dataclasses.replace(
+        tiny_config, round=6, lr_schedule="cosine", lr_min_factor=0.1
+    )
+    from distributed_learning_simulator_tpu.simulator import _lr_factor
+
+    vec = lr_factors(cfg, 2, 3)
+    assert vec.dtype == np.float32 and vec.shape == (3,)
+    for i in range(3):
+        assert vec[i] == np.float32(_lr_factor(cfg, 2 + i))
+
+
+# ------------------------------------------------------- K=1 default pin
+
+
+def test_k1_default_keeps_per_round_program(tiny_config, tmp_path):
+    """The default dispatches the per-round program exactly as before:
+    warmup compiles name round_fn/server_eval (never the batched scan),
+    0 post-warmup compiles, records carry no dispatch_rounds marker, and
+    an explicit rounds_per_dispatch=1 writes byte-identical metrics
+    lines to the default."""
+    cfg = dataclasses.replace(
+        tiny_config, round=3, telemetry_level="basic",
+        compilation_cache_dir=None, log_root=str(tmp_path / "log_a"),
+    )
+    result = run_simulation(cfg)
+    assert result["post_warmup_compiles"] == 0
+
+    def read_records(root):
+        paths = glob.glob(os.path.join(root, "**", "metrics.jsonl"),
+                          recursive=True)
+        assert len(paths) == 1
+        with open(paths[0]) as f:
+            return f.read()
+
+    lines_a = read_records(str(tmp_path / "log_a"))
+    records = [json.loads(line) for line in lines_a.splitlines()]
+    warmup_names = records[0]["telemetry"]["compiled"]
+    assert any("round_fn" in n for n in warmup_names)
+    assert not any("batched" in n for n in warmup_names)
+    for r in records:
+        assert "dispatch_rounds" not in r["telemetry"]
+
+    explicit = dataclasses.replace(
+        cfg, rounds_per_dispatch=1, log_root=str(tmp_path / "log_b"),
+    )
+    run_simulation(explicit)
+    lines_b = read_records(str(tmp_path / "log_b"))
+    strip = lambda text: [  # noqa: E731 — timing fields differ run-to-run
+        {k: v for k, v in json.loads(line).items()
+         if k not in ("round_seconds",) and k != "telemetry"}
+        for line in text.splitlines()
+    ]
+    assert strip(lines_a) == strip(lines_b)
+
+
+# --------------------------------------------------- K>1 differential
+
+
+def test_k3_matches_k1_fedavg_full_feature(tiny_config):
+    """FedAvg with participation sampling, dropout faults, quorum, a
+    cosine lr schedule, and a momentum server optimizer: K=3 (dispatch
+    sizes 3 then 1 — the remainder dispatch included) must reproduce the
+    K=1 history bit-for-bit, cohort hashes and failure draws included."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=8, round=4,
+        participation_fraction=0.5, failure_mode="dropout",
+        failure_prob=0.3, min_survivors=2, lr_schedule="cosine",
+        server_optimizer_name="sgd", server_learning_rate=1.0,
+        server_momentum=0.9,
+    )
+    keys = ("test_accuracy", "test_loss", "mean_client_loss", "lr_factor",
+            "survivor_count", "round_rejected", "cohort_hash")
+    base = _series(_run(cfg), *keys)
+    batched = _series(_run(cfg, rounds_per_dispatch=3), *keys)
+    assert base == batched
+    assert None not in base["cohort_hash"]  # sampling actually exercised
+
+
+def test_k2_matches_k1_sign_sgd(tiny_config):
+    """sign_SGD (momentum, straggler faults, quorum): the per-step vote
+    loop scans identically inside the batched dispatch."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="sign_SGD", learning_rate=0.01,
+        momentum=0.9, round=3, failure_mode="straggler", failure_prob=0.3,
+        min_survivors=1,
+    )
+    keys = ("test_accuracy", "test_loss", "mean_client_loss",
+            "survivor_count", "round_rejected", "uplink_compression_ratio")
+    assert _series(_run(cfg), *keys) == _series(
+        _run(cfg, rounds_per_dispatch=2), *keys
+    )
+
+
+# ----------------------------------------------- checkpoint/resume + tel
+
+
+def test_checkpoint_resume_non_aligned_boundary(tiny_config, tmp_path):
+    """checkpoint_every=3 with K=4: dispatch sizes clip to the boundary
+    (3, then 1 at the round=4 horizon), the checkpoint lands mid-run on
+    a non-K-aligned round, and the resumed batched run stitches a
+    history bit-identical to an uninterrupted K=1 run."""
+    cfg = dataclasses.replace(
+        tiny_config, round=6, momentum=0.9,
+        server_optimizer_name="sgd", server_momentum=0.9,
+    )
+    golden = [h["test_accuracy"] for h in _run(cfg)["history"]]
+
+    ckpt = str(tmp_path / "ckpt")
+    first = _run(cfg, round=4, rounds_per_dispatch=4,
+                 checkpoint_dir=ckpt, checkpoint_every=3)
+    assert sorted(os.listdir(ckpt)) == ["round_2.ckpt"]
+    resumed = _run(cfg, rounds_per_dispatch=4, checkpoint_dir=ckpt,
+                   checkpoint_every=3, resume=True)
+    assert [h["round"] for h in resumed["history"]] == [3, 4, 5]
+    stitched = [h["test_accuracy"] for h in first["history"][:3]] + [
+        h["test_accuracy"] for h in resumed["history"]
+    ]
+    assert stitched == golden
+
+
+def test_batched_telemetry_per_dispatch(tiny_config, tmp_path):
+    """K=2 with telemetry + client_stats: one telemetry sub-object per
+    dispatch (on its LAST record, stamped dispatch_rounds), client-stats
+    rows on their cadence, 0 post-warmup compiles (each dispatch length
+    is warmup once), schema-valid records, and report_run renders
+    per-dispatch without double-counting."""
+    import importlib.util
+
+    import jsonschema
+
+    cfg = dataclasses.replace(
+        tiny_config, round=4, rounds_per_dispatch=2,
+        telemetry_level="basic", client_stats="on", client_stats_every=2,
+        compilation_cache_dir=None, log_root=str(tmp_path / "log"),
+    )
+    result = run_simulation(cfg)
+    assert result["post_warmup_compiles"] == 0
+    paths = glob.glob(os.path.join(cfg.log_root, "**", "metrics.jsonl"),
+                      recursive=True)
+    with open(paths[0]) as f:
+        records = [json.loads(line) for line in f]
+    assert [r["round"] for r in records] == [0, 1, 2, 3]
+    with open(os.path.join(os.path.dirname(__file__), "data",
+                           "metrics_record.schema.json")) as f:
+        schema = json.load(f)
+    for r in records:
+        jsonschema.validate(r, schema)
+    # Telemetry on dispatch-last records only; stats rows on the cadence.
+    assert [("telemetry" in r) for r in records] == [
+        False, True, False, True,
+    ]
+    assert [("client_stats" in r) for r in records] == [
+        True, False, True, False,
+    ]
+    for r in (records[1], records[3]):
+        assert r["telemetry"]["dispatch_rounds"] == 2
+        assert {"client_step", "host_sync", "post_round"} <= set(
+            r["telemetry"]["phase_seconds"]
+        )
+    assert records[1]["telemetry"]["compiles"] > 0  # warmup dispatch
+    assert records[1]["telemetry"]["warmup"] is True
+    assert records[3]["telemetry"]["compiles"] == 0
+
+    spec = importlib.util.spec_from_file_location(
+        "report_run",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "report_run.py"),
+    )
+    report_run = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report_run)
+    summary = report_run.summarize_run(report_run.load_metrics(
+        os.path.dirname(paths[0])
+    ))
+    assert summary["phase_unit"] == "dispatch"
+    assert summary["compiles"]["post_warmup"] == 0
+    assert summary["compiles"]["warmup"] > 0
+    # No double-counting: the summary totals equal the record sums.
+    rec_total = sum(
+        sum(r["telemetry"]["phase_seconds"].values())
+        for r in records if "telemetry" in r
+    )
+    sum_total = sum(st["total_s"] for st in summary["phases"].values())
+    assert abs(rec_total - sum_total) < 1e-3
+    rendered = "\n".join(report_run.render_summary(summary))
+    assert "per-dispatch mean" in rendered
+    assert "post-warmup recompiles: none" in rendered
