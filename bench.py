@@ -44,6 +44,12 @@ program-defining field. The ``round_batch`` sub-object sweeps
 program and records the wall-based K-vs-1 ``amortization_ratio``
 (docs/PERFORMANCE.md § Round batching) — compare_bench.py gates it
 absolutely (--batch-amortization-threshold); BENCH_ROUND_BATCH=0 skips.
+The ``async`` sub-object runs the headline program under the 80/20
+fast/slow arrival population (async_mode='on', docs/ROBUSTNESS.md §
+Asynchronous federation) and records the simulated-clock
+``async_speedup_ratio`` — compare_bench.py gates it absolutely
+(--async-speedup-threshold); BENCH_ASYNC=0 skips,
+BENCH_ASYNC_ROUNDS sets its length.
 """
 
 from __future__ import annotations
@@ -352,6 +358,49 @@ def main():
             # >= 1.0 means batching pays: K rounds per dispatch move at
             # least as fast as one-round dispatches.
             "amortization_ratio": round(rb_rates[rb_k] / rb_rates[1], 4),
+        }
+
+    # Asynchronous federation (ISSUE 6, config.async_mode): the headline
+    # program under the documented 80/20 fast/slow population with
+    # deadline rounds + the staleness buffer (docs/ROBUSTNESS.md §
+    # Asynchronous federation). Records the run's simulated-clock
+    # async_speedup_ratio (deadline rounds vs the wait-for-everyone sync
+    # counterfactual, computed from the SAME arrival draws — a
+    # deterministic program property, not wall-clock), gated by
+    # scripts/compare_bench.py --async-speedup-threshold as an in-record
+    # ABSOLUTE floor, same pattern as the round_batch gate. The async
+    # knobs land in config_hash like every other program-defining field,
+    # so async and sync headline runs can never be silently diffed.
+    # BENCH_ASYNC=0 skips; BENCH_ASYNC_ROUNDS sets the length.
+    run_async = (
+        os.environ.get("BENCH_ASYNC", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_async:
+        a_rounds = int(os.environ.get("BENCH_ASYNC_ROUNDS", "8"))
+        a_config = ExperimentConfig(
+            model_name=model, round=a_rounds + 1, client_chunk_size=chunk,
+            local_compute_dtype=dtype,
+            async_mode="on", arrival_model="bimodal",
+            arrival_slow_fraction=0.2, arrival_slow_factor=8.0,
+            round_deadline=1.5, async_buffer_size=8, staleness_alpha=0.5,
+            **failure_knobs, **common,
+        )
+        a_times, a_result = _run(
+            a_config, dataset=dataset, client_data=client_data
+        )
+        ar = _rates(a_times, n_clients)
+        record["async"] = {
+            "value": round(ar["median_rate"], 2),
+            "rounds": a_rounds,
+            "round_ms": {k: round(v, 1) for k, v in ar["round_ms"].items()},
+            "async_speedup_ratio": round(a_result["async_speedup_ratio"], 4),
+            "sim_clock_s": round(a_result["sim_clock_seconds"], 3),
+            "mean_buffer_occupancy": round(
+                a_result["mean_buffer_occupancy"], 3
+            ),
+            "final_accuracy": a_result["final_accuracy"],
         }
 
     # Converged-GTG round wall-clock at the north-star population (ISSUE 1:

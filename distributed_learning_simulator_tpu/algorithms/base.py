@@ -76,6 +76,16 @@ class Algorithm:
     # False — a third-party post_round reading ctx.global_params would
     # silently get wrong values; FedAvg/SignSGD opt in.
     supports_round_batching: bool = False
+    # Whether the round program implements asynchronous federation
+    # (config.async_mode='on'; robustness/arrivals.py): deadline rounds,
+    # the staleness buffer carried as round state, and the extra
+    # ``async_state`` round_fn operand. Conservative default False — the
+    # simulator refuses async_mode='on' with the cause instead of
+    # silently running the algorithm synchronously; the FedAvg family
+    # opts in (sign_SGD's shared-vote round has no parameter-space
+    # buffer to hold late updates; the Shapley servers refuse in their
+    # constructors — subset utilities assume a synchronous cohort).
+    supports_async: bool = False
 
     def __init__(self, config):
         self.config = config

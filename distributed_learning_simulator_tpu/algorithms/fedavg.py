@@ -28,6 +28,9 @@ from distributed_learning_simulator_tpu.parallel.engine import (
     chunked_accumulate,
     make_local_train_fn,
 )
+from distributed_learning_simulator_tpu.robustness.arrivals import (
+    AsyncFederation,
+)
 from distributed_learning_simulator_tpu.robustness.faults import (
     FailureModel,
     all_finite,
@@ -40,6 +43,11 @@ from distributed_learning_simulator_tpu.telemetry.client_stats import (
 class FedAvg(Algorithm):
     name = "fed"
     supports_lr_schedule = True  # round_fn accepts the lr_scale operand
+    # Asynchronous federation (config.async_mode; robustness/arrivals.py):
+    # the round program implements deadline rounds + the staleness buffer
+    # (carried via the async_state operand / aux key). fed_quant inherits
+    # — its payload transform applies to fresh and late uploads alike.
+    supports_async = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -215,6 +223,16 @@ class FedAvg(Algorithm):
         fm = FailureModel.from_config(cfg)
         min_survivors = getattr(cfg, "min_survivors", 0)
         quorum = fm is not None or min_survivors > 0
+        # Asynchronous federation (robustness/arrivals.py): like fm/cs,
+        # every af-gated branch below is a TRACE-TIME conditional —
+        # async_mode='off' (the default) compiles the exact pre-feature
+        # program, and the arrival stream is fold_in-decoupled from the
+        # round key's splits, so async draws re-roll nothing else. The
+        # persistent population speeds are a build-time constant table.
+        af = AsyncFederation.from_config(cfg)
+        arrival_speeds = (
+            af.speed_table(n_clients) if af is not None else None
+        )
 
         # --- size-aware work scheduling (config.bucket_client_work) --------
         # The packed-shard discipline makes every client scan
@@ -302,11 +320,13 @@ class FedAvg(Algorithm):
             dropout freezes the chunk's persistent state."""
 
             def compute(chunk_trees, pk):
-                if fm is None:
-                    state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees
-                    f_c = None
-                else:
-                    state_c, x_c, y_c, m_c, keys_c, w_c, f_c = chunk_trees
+                # Tree layout: (state, x, y, m, keys, w[, late_w][, failed])
+                # — the optional members appear in that order exactly when
+                # their trace-time feature (af / fm) is active.
+                state_c, x_c, y_c, m_c, keys_c, w_c = chunk_trees[:6]
+                rest = list(chunk_trees[6:])
+                lw_c = rest.pop(0) if af is not None else None
+                f_c = rest.pop(0) if fm is not None else None
                 cp, ns, tm = vtrain(global_params, state_c, x_c, y_c, m_c,
                                     keys_c, lr_scale)
                 if f_c is not None and fm.corrupts_upload:
@@ -318,33 +338,55 @@ class FedAvg(Algorithm):
                     # delta probe per client — never the stack), AFTER
                     # corruption: they describe what the server received.
                     tm = cs.add_upload_stats(tm, global_params, cp)
-                return reduce_chunk(cp, w_c, pk), (ns, tm)
+                return reduce_chunk(cp, w_c, pk, lw_c), (ns, tm)
 
             return compute
 
-        def reduce_chunk(cp, w, pk):
+        def reduce_chunk(cp, w, pk, lw=None):
             cp, _ = self.process_client_payload(cp, pk)
+
             # Weighted partial sum accumulated in f32 even when client
             # params are bf16 (local_compute_dtype): a sum over up to
             # 1000 small weighted terms must not round at 8 bits of
             # mantissa. The MXU takes bf16 inputs with an f32
             # accumulator natively.
-            return jax.tree_util.tree_map(
-                lambda p: jnp.tensordot(
-                    w.astype(jnp.float32), p, axes=(0, 0),
-                    preferred_element_type=jnp.float32,
-                ),
-                cp,
-            )
+            def wsum(weights):
+                return jax.tree_util.tree_map(
+                    lambda p: jnp.tensordot(
+                        weights.astype(jnp.float32), p, axes=(0, 0),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    cp,
+                )
+
+            if lw is None:
+                return wsum(w)
+            # Async federation: the late row is a SECOND weighted sum over
+            # the same payload-processed chunk (raw discounted weights —
+            # normalized at buffer-apply time), kept as a separate
+            # tensordot so the fresh row's ops stay identical to the
+            # synchronous program (the round_deadline=inf bit-identity
+            # contract).
+            return (wsum(w), wsum(lw))
+
+        def zero_acc(global_params):
+            """Zero accumulator matching reduce_chunk's output: one tree
+            for the synchronous reduction, a (fresh, late) pair under
+            async federation."""
+            z = jax.tree_util.tree_map(jnp.zeros_like, global_params)
+            if af is None:
+                return z
+            return (z, jax.tree_util.tree_map(jnp.zeros_like, global_params))
 
         def train_and_reduce(global_params, state, x, y, m, keys, norm_w,
-                             failed, payload_key, lr_scale):
+                             late_w, failed, payload_key, lr_scale):
             """Fused path: per-chunk weighted partial sums accumulate into
             the aggregate directly, so the full [n_clients, n_params] stack
             never materializes — at 1000 clients x ResNet-18 that stack
             would be ~44 GB, far beyond HBM. ``failed`` is the failure
-            model's per-client mask (None when inactive). Returns
-            (aggregate, new_state, train_metrics)."""
+            model's per-client mask, ``late_w`` the async late-upload
+            weights (None when the feature is inactive). Returns
+            (aggregate[, late_sum], new_state, train_metrics)."""
             k = keys.shape[0]
 
             if chunk is None or chunk >= k:
@@ -357,34 +399,36 @@ class FedAvg(Algorithm):
                     ns = fm.freeze_failed_state(failed, state, ns)
                 if cs is not None:
                     tm = cs.add_upload_stats(tm, global_params, cp)
-                return reduce_chunk(cp, norm_w, payload_key), ns, tm
+                return reduce_chunk(cp, norm_w, payload_key, late_w), ns, tm
 
             # chunked_accumulate handles the reshape/scan/remainder
             # discipline (remainder participants get their own vmap call so
             # the memory-safe path never silently degrades to materializing
             # the full per-client param stack) and splits payload_key into
             # per-chunk keys itself.
-            acc0 = jax.tree_util.tree_map(jnp.zeros_like, global_params)
             trees = (state, x, y, m, keys, norm_w)
+            if af is not None:
+                trees = trees + (late_w,)
             if fm is not None:
                 trees = trees + (failed,)
             agg, (ns, tm) = chunked_accumulate(
                 trees, chunk,
-                make_compute(global_params, lr_scale), acc0,
+                make_compute(global_params, lr_scale),
+                zero_acc(global_params),
                 per_chunk=payload_key,
             )
             return agg, ns, tm
 
         def train_and_reduce_bucketed(plan, global_params, state, x, y, m,
-                                      keys, norm_w, failed, payload_key,
-                                      lr_scale):
+                                      keys, norm_w, late_w, failed,
+                                      payload_key, lr_scale):
             """Fused path with the size-aware schedule: one chunked scan per
             step-count group, each slicing the slot axis to the group's own
             length. Groups accumulate into the same f32 aggregate; per-client
             metrics (and persistent state, if any) scatter back to original
             client positions."""
             n = keys.shape[0]
-            agg = jax.tree_util.tree_map(jnp.zeros_like, global_params)
+            agg = zero_acc(global_params)
             # Per-client metrics scatter back to original client positions;
             # the dict is keyed by whatever the compute body reports (loss/
             # accuracy always; the client_stats probe and scalars when on),
@@ -416,6 +460,8 @@ class FedAvg(Algorithm):
                     keys[idx],
                     take(norm_w),
                 )
+                if af is not None:
+                    trees_g = trees_g + (take(late_w),)
                 if fm is not None:
                     trees_g = trees_g + (take(failed),)
                 if idx_np.size <= chunk:
@@ -423,7 +469,7 @@ class FedAvg(Algorithm):
                 else:
                     partial, (ns_g, tm_g) = chunked_accumulate(
                         trees_g, chunk, compute,
-                        jax.tree_util.tree_map(jnp.zeros_like, global_params),
+                        zero_acc(global_params),
                         per_chunk=gk,
                     )
                 agg = jax.tree_util.tree_map(jnp.add, agg, partial)
@@ -446,7 +492,15 @@ class FedAvg(Algorithm):
             return agg, new_state, metrics_full
 
         def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
-                     lr_scale=1.0):
+                     lr_scale=1.0, async_state=None):
+            if af is not None and async_state is None:
+                # Trace-time wiring check: the simulator owns the buffer
+                # carry; a direct caller forgetting it would otherwise
+                # train with a silently-fresh buffer every round.
+                raise ValueError(
+                    "async_mode='on' round program needs the async_state "
+                    "operand (AsyncFederation.init_state)"
+                )
             if fm is not None:
                 # The extra split is gated so failure-free runs keep the
                 # exact pre-feature RNG streams (bit-compatible histories).
@@ -476,15 +530,60 @@ class FedAvg(Algorithm):
                 state_k = jax.tree_util.tree_map(take, client_state)
                 x_k, y_k, m_k = take(cx), take(cy), take(cmask)
                 part_sizes = jnp.take(sizes, idx, axis=0)
+            routed_late = None
             if failed is not None and fm.excludes_update:
-                # Dropout/straggler: zero aggregation weight. The weighted
-                # mean renormalizes over the SURVIVING part_sizes (total
-                # below shrinks too), and the robust rules' weights>0
-                # participation mask excludes failed clients from the
-                # per-coordinate statistic.
-                part_sizes = part_sizes * survival.astype(part_sizes.dtype)
+                if af is not None and fm.routes_to_buffer:
+                    # Straggler fault + arrival model: the upload "arrives
+                    # after the deadline" — routed into the staleness
+                    # buffer (weight kept; forced late below) instead of
+                    # silently discarded, and the client counts as a
+                    # survivor (nothing was lost, only delayed). Sync-mode
+                    # straggler semantics are untouched.
+                    routed_late = failed
+                    survival = jnp.ones_like(failed)
+                else:
+                    # Dropout/straggler: zero aggregation weight. The
+                    # weighted mean renormalizes over the SURVIVING
+                    # part_sizes (total below shrinks too), and the robust
+                    # rules' weights>0 participation mask excludes failed
+                    # clients from the per-coordinate statistic.
+                    part_sizes = part_sizes * survival.astype(part_sizes.dtype)
+            late_w = None
+            if af is not None:
+                # Arrival model (robustness/arrivals.py): latencies from
+                # the fold_in-decoupled stream keyed by TRUE client index
+                # — the splits above are untouched, so the deadline=inf
+                # degenerate case replays the synchronous run bit-exactly.
+                ids = idx if idx is not None else jnp.arange(n_participants)
+                latency = af.draw_latency(
+                    key, ids, jnp.take(arrival_speeds, ids, axis=0)
+                )
+                on_time, staleness, discount, eff_latency = af.classify(
+                    latency, routed_late
+                )
+                # Effective latencies: fault-routed stragglers are
+                # delayed one deadline, so the simulated clock and the
+                # staleness telemetry describe the same arrivals.
+                sim_duration, sim_duration_sync = af.durations(eff_latency)
+                late_mask = (~on_time) & (part_sizes > 0)
+                late_w = (
+                    part_sizes.astype(jnp.float32)
+                    * discount
+                    * late_mask.astype(jnp.float32)
+                )
+                b_tot = jnp.sum(late_w)
+                n_late = jnp.sum(late_mask.astype(jnp.int32))
+                mean_staleness = jnp.sum(
+                    staleness * late_mask.astype(jnp.float32)
+                ) / jnp.maximum(n_late.astype(jnp.float32), 1.0)
+                # Fresh cohort = on-time clients only; late weights keep
+                # the pre-deadline sizes, so a client contributes through
+                # exactly one row.
+                part_sizes = part_sizes * on_time.astype(part_sizes.dtype)
             total_size = jnp.sum(part_sizes)
             norm_w = part_sizes / jnp.maximum(total_size, 1e-12)
+            if af is not None:
+                on_time_count = jnp.sum((part_sizes > 0).astype(jnp.int32))
 
             aux = {}
             if materialize:
@@ -528,6 +627,18 @@ class FedAvg(Algorithm):
                 client_params, payload_aux = self.process_client_payload(
                     client_params, payload_key
                 )
+                late_sum = None
+                if af is not None:
+                    # Same post-payload point as the fused path's late row
+                    # (a late fed_quant client quantizes its own upload
+                    # before it reaches the buffer).
+                    late_sum = jax.tree_util.tree_map(
+                        lambda p: jnp.tensordot(
+                            late_w, p, axes=(0, 0),
+                            preferred_element_type=jnp.float32,
+                        ),
+                        client_params,
+                    )
                 new_global = aggregate(
                     client_params, part_sizes, aggregation, cfg.trim_ratio
                 )
@@ -561,26 +672,45 @@ class FedAvg(Algorithm):
                         # plain path (bit-identical to scheduling-off).
                         plan = None
                 if plan is not None:
-                    new_global, new_state_k, train_metrics = (
+                    agg_out, new_state_k, train_metrics = (
                         train_and_reduce_bucketed(
                             plan, global_params, state_k, x_k, y_k, m_k,
-                            client_keys, norm_w, failed, payload_key,
-                            lr_scale,
+                            client_keys, norm_w, late_w, failed,
+                            payload_key, lr_scale,
                         )
                     )
                 else:
-                    new_global, new_state_k, train_metrics = train_and_reduce(
+                    agg_out, new_state_k, train_metrics = train_and_reduce(
                         global_params, state_k, x_k, y_k, m_k, client_keys,
-                        norm_w, failed, payload_key, lr_scale,
+                        norm_w, late_w, failed, payload_key, lr_scale,
                     )
+                if af is not None:
+                    new_global, late_sum = agg_out
+                else:
+                    new_global = agg_out
                 payload_aux = {}
+            keep_round = total_size > 0
+            if af is not None:
+                # Staleness buffer (robustness/arrivals.py): insert this
+                # round's late batch, fire the K-of-N trigger, mix the
+                # buffered mean delta into the aggregate at its weight
+                # share. A non-triggering round returns the fresh
+                # aggregate through a bit-exact select.
+                (new_global, buffer_applied, astate_ins,
+                 astate_next) = af.absorb_and_apply(
+                    async_state, global_params, new_global, total_size,
+                    late_sum, b_tot, n_late, sim_duration,
+                )
+                # A buffer-only round (whole cohort late) is a real
+                # update, not an empty round.
+                keep_round = keep_round | buffer_applied
             # Empty effective cohort (all sampled clients have zero samples,
             # possible under extreme Dirichlet skew — or the whole cohort
-            # dropped out): keep the previous global model, parity with
-            # fed_server.py:45-47.
+            # dropped out / missed the deadline): keep the previous global
+            # model, parity with fed_server.py:45-47.
             new_global = jax.tree_util.tree_map(
                 lambda agg, prev: jnp.where(
-                    total_size > 0, agg, prev.astype(agg.dtype)
+                    keep_round, agg, prev.astype(agg.dtype)
                 ),
                 new_global, global_params,
             )
@@ -626,6 +756,34 @@ class FedAvg(Algorithm):
                     ),
                     new_global, global_params,
                 )
+            if af is not None:
+                if quorum:
+                    # A rejected round keeps its buffer INSERTS (the late
+                    # uploads really arrived) but reverts any trigger/reset
+                    # — the refused aggregate never consumed them; the
+                    # trigger re-fires next round.
+                    new_async_state = jax.tree_util.tree_map(
+                        lambda ins, nxt: jnp.where(rejected, ins, nxt),
+                        astate_ins, astate_next,
+                    )
+                    applied_eff = buffer_applied & ~rejected
+                else:
+                    new_async_state = astate_next
+                    applied_eff = buffer_applied
+                # The buffer carry rides aux: the host loop (and the
+                # batched scan) pops it and feeds it back as the next
+                # round's async_state operand.
+                aux["async_state"] = new_async_state
+                aux.update({
+                    "on_time_count": on_time_count,
+                    "late_count": n_late,
+                    "buffer_count": new_async_state["buf_count"],
+                    "buffer_applied": applied_eff,
+                    "mean_staleness": mean_staleness,
+                    "sim_duration": sim_duration,
+                    "sim_duration_sync": sim_duration_sync,
+                    "sim_clock": new_async_state["clock"],
+                })
             if idx is not None:
                 # Sampled cohort indices: third-party post_round attribution
                 # and the host loop's cohort_hash resume-determinism

@@ -472,6 +472,15 @@ def _check_shapley_config(config) -> None:
             "memo assumes a fixed cohort of honest updates; set "
             "failure_mode='none'"
         )
+    if getattr(config, "async_mode", "off").lower() == "on":
+        # Same fixed-cohort assumption against the time axis: a late
+        # upload applied rounds later (robustness/arrivals.py) has no
+        # place in a subset utility evaluated against THIS round's
+        # metric — refuse rather than attribute stale updates.
+        raise ValueError(
+            "Shapley scoring refuses async_mode='on': subset utilities "
+            "assume a synchronous fixed cohort; set async_mode='off'"
+        )
 
 
 class MultiRoundShapley(FedAvg):

@@ -102,6 +102,46 @@ class ExperimentConfig:
     # Re-rolls WHICH clients fail without touching cohort sampling,
     # training batches, or payload keys (fold_in-decoupled stream).
     failure_seed: int = 0
+    # --- asynchronous federation (robustness/arrivals.py) -------------------
+    # "off" (default): every algorithm runs its exact synchronous-round
+    # program (the async machinery is never constructed — trace-time
+    # gated like failure_mode). "on": deadline rounds with buffered
+    # staleness-weighted aggregation — clients beating round_deadline
+    # contribute fresh, late uploads land in a device-resident FedBuff-
+    # style buffer applied (with a polynomial staleness discount) once
+    # async_buffer_size uploads accumulate. FedAvg family only (fed,
+    # fed_quant); sign_SGD, the Shapley servers, and the threaded oracle
+    # refuse. round_deadline=inf reproduces sync FedAvg bit-for-bit from
+    # the compiled async program (tests/test_async.py).
+    async_mode: str = "off"
+    # Simulated per-client upload latency, drawn per round from the round
+    # key via a fold_in-decoupled stream (activating it re-rolls nothing
+    # else): "bimodal" = persistent 80/20 fast/slow population x uniform
+    # [0.5, 1.5) jitter; "lognormal" = population factor x
+    # exp(arrival_sigma * N(0,1)). Required (non-"none") when
+    # async_mode='on'.
+    arrival_model: str = "none"
+    # Share of the population that is persistently slow, and how much
+    # slower it is (the 80/20 heterogeneity knob: defaults model 20% of
+    # clients at 8x the upload latency).
+    arrival_slow_fraction: float = 0.2
+    arrival_slow_factor: float = 8.0
+    # Spread of the lognormal per-round jitter (lognormal model only).
+    arrival_sigma: float = 0.5
+    # Re-rolls WHICH clients are slow (and their jitter) without touching
+    # cohort sampling, training batches, failure draws, or payload keys.
+    arrival_seed: int = 0
+    # Simulated-time budget a round waits for uploads (same units as the
+    # arrival model's latencies; a fast client's mean latency is ~1.0).
+    # inf = wait for everyone — the synchronous degenerate case.
+    round_deadline: float = float("inf")
+    # FedBuff K-of-N trigger: the staleness buffer's accumulated late
+    # uploads are applied once their count reaches this.
+    async_buffer_size: int = 8
+    # Exponent of the polynomial staleness discount (1 + s)^(-alpha)
+    # weighting a late upload s rounds after its round closed. 0 = full
+    # weight regardless of staleness.
+    staleness_alpha: float = 0.5
     # Quorum policy (host loop + round program): a round whose survivor
     # count falls below min_survivors — or whose aggregate is non-finite —
     # is REJECTED in-program: the previous global model is retained, and
@@ -455,6 +495,39 @@ class ExperimentConfig:
                     "failures; use execution_mode='vmap' with a failure "
                     "model"
                 )
+        from distributed_learning_simulator_tpu.robustness.arrivals import (
+            ARRIVAL_MODES as _ARRIVAL_MODES,
+            AsyncFederation,
+        )
+
+        if self.arrival_model not in _ARRIVAL_MODES:
+            # Checked even at async_mode='off' so a typo fails fast
+            # instead of surfacing only when async is later turned on.
+            raise ValueError(
+                f"unknown arrival_model {self.arrival_model!r}; known: "
+                + ", ".join(_ARRIVAL_MODES)
+            )
+        # The ONE authoritative async_mode / arrival-model gate (unknown
+        # mode, arrival_model='none' under async) — from_config raises
+        # the same errors direct library users see.
+        AsyncFederation.from_config(self)
+        if self.async_mode.lower() == "on":
+            if not self.round_deadline > 0.0:
+                raise ValueError("round_deadline must be > 0 (inf = sync)")
+            if self.async_buffer_size < 1:
+                raise ValueError("async_buffer_size must be >= 1")
+            if self.staleness_alpha < 0.0:
+                raise ValueError("staleness_alpha must be >= 0")
+            if not 0.0 <= self.arrival_slow_fraction <= 1.0:
+                raise ValueError(
+                    "arrival_slow_fraction must be in [0, 1]"
+                )
+            if self.arrival_slow_factor < 1.0:
+                raise ValueError("arrival_slow_factor must be >= 1")
+            if self.arrival_model == "lognormal" and self.arrival_sigma <= 0.0:
+                # sigma is the lognormal jitter spread only; a bimodal
+                # run must not be refused over a knob it never reads.
+                raise ValueError("arrival_sigma must be > 0")
         if self.checkpoint_keep_last is not None and (
             self.checkpoint_keep_last < 1
         ):

@@ -710,6 +710,15 @@ def run_threaded_simulation(
             "threaded execution mode does not support client_eval=True; "
             "use the vmap execution mode"
         )
+    if getattr(config, "async_mode", "off").lower() == "on":
+        # The thread-per-client oracle reproduces the reference's blocking
+        # rendezvous barrier — the exact architecture deadline rounds and
+        # the staleness buffer (robustness/arrivals.py) replace; running
+        # it synchronously would silently ignore the requested semantics.
+        raise ValueError(
+            "threaded execution mode does not support async_mode='on'; "
+            "use the vmap execution mode"
+        )
     if (
         config.client_eval is None
         and algo_name == "fed_quant"

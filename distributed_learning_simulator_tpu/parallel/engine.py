@@ -359,7 +359,7 @@ def chunked_accumulate(trees, chunk: int, compute_fn, acc0, per_chunk=None):
 
 
 def make_batched_round_fn(round_fn, server_update_fn, eval_fn, length: int,
-                          lr_schedule: bool):
+                          lr_schedule: bool, async_mode: bool = False):
     """Fuse ``length`` federated rounds into ONE dispatchable program
     (config.rounds_per_dispatch; docs/PERFORMANCE.md § Round batching).
 
@@ -380,43 +380,72 @@ def make_batched_round_fn(round_fn, server_update_fn, eval_fn, length: int,
     round fn is called WITHOUT the operand so the constant default
     constant-folds exactly as in the unbatched program.
 
+    ``async_mode`` (trace-time; config.async_mode='on'): the round fn's
+    staleness-buffer state (robustness/arrivals.py) joins the scan carry
+    — each iteration feeds the previous round's ``aux['async_state']``
+    back as the ``async_state`` operand, exactly replaying the host
+    loop's pop-and-refeed sequence, and the dispatch returns the final
+    buffer state as a trailing output. The carried state is popped from
+    aux BEFORE stacking (a param-sized buffer stacked K times would
+    defeat the point of one accumulator).
+
     Returns ``batched(global_params, client_state, server_state, key,
-    cx, cy, cmask, sizes, eval_batches[, lr_vec]) -> (new_global,
-    new_client_state, new_server_state, new_key, metrics_k, aux_k)``.
-    ``client_state``/``server_state`` may be None (absent state carries
-    through the scan as an empty subtree). Algorithms opt in via
-    ``Algorithm.supports_round_batching`` — the scan stacks every aux
-    leaf, so aux must not carry per-round parameter STACKS, and
-    post_round hooks only see dispatch-granular params.
+    cx, cy, cmask, sizes, eval_batches[, lr_vec][, async_state]) ->
+    (new_global, new_client_state, new_server_state, new_key, metrics_k,
+    aux_k[, async_state])``. ``client_state``/``server_state`` may be
+    None (absent state carries through the scan as an empty subtree).
+    Algorithms opt in via ``Algorithm.supports_round_batching`` — the
+    scan stacks every aux leaf, so aux must not carry per-round
+    parameter STACKS, and post_round hooks only see dispatch-granular
+    params.
     """
 
     def batched(global_params, client_state, server_state, key,
-                cx, cy, cmask, sizes, eval_batches, lr_vec=None):
+                cx, cy, cmask, sizes, eval_batches, lr_vec=None,
+                async_state=None):
         def body(carry, lr_k):
-            gp, cstate, sstate, k = carry
+            if async_mode:
+                gp, cstate, sstate, k, astate = carry
+                kw = {"async_state": astate}
+            else:
+                gp, cstate, sstate, k = carry
+                kw = {}
             k, round_key = jax.random.split(k)
             if lr_schedule:
                 new_gp, cstate, aux = round_fn(
-                    gp, cstate, cx, cy, cmask, sizes, round_key, lr_k
+                    gp, cstate, cx, cy, cmask, sizes, round_key, lr_k, **kw
                 )
             else:
                 new_gp, cstate, aux = round_fn(
-                    gp, cstate, cx, cy, cmask, sizes, round_key
+                    gp, cstate, cx, cy, cmask, sizes, round_key, **kw
                 )
+            if async_mode:
+                aux = dict(aux)
+                astate = aux.pop("async_state")
             if server_update_fn is not None:
                 srv_args = (gp, new_gp, sstate)
                 if "round_rejected" in aux:
                     srv_args += (aux["round_rejected"],)
                 new_gp, sstate = server_update_fn(*srv_args)
             metrics = eval_fn(new_gp, *eval_batches)
-            return (new_gp, cstate, sstate, k), (metrics, aux)
+            carry = (
+                (new_gp, cstate, sstate, k, astate) if async_mode
+                else (new_gp, cstate, sstate, k)
+            )
+            return carry, (metrics, aux)
 
         carry0 = (global_params, client_state, server_state, key)
-        (gp, cstate, sstate, key), (metrics_k, aux_k) = jax.lax.scan(
+        if async_mode:
+            carry0 = carry0 + (async_state,)
+        carry_out, (metrics_k, aux_k) = jax.lax.scan(
             body, carry0,
             lr_vec if lr_schedule else None,
             length=None if lr_schedule else length,
         )
+        if async_mode:
+            gp, cstate, sstate, key, astate = carry_out
+            return gp, cstate, sstate, key, metrics_k, aux_k, astate
+        gp, cstate, sstate, key = carry_out
         return gp, cstate, sstate, key, metrics_k, aux_k
 
     return batched

@@ -5,10 +5,16 @@ reports back deadlocks the server's blocking barrier forever
 (fed_server.py:75-77). This package provides the *attack* side that the
 repo's existing defenses (robust aggregation rules, atomic checkpoints)
 were missing: an injectable per-round client failure model
-(:mod:`.faults`) and a deterministic crash-injection hook for the chaos
-harness (:mod:`.chaos`).
+(:mod:`.faults`), a deterministic crash-injection hook for the chaos
+harness (:mod:`.chaos`), and the asynchronous-federation subsystem —
+device-side arrival model, deadline rounds, staleness buffer
+(:mod:`.arrivals`).
 """
 
+from distributed_learning_simulator_tpu.robustness.arrivals import (  # noqa: F401
+    AsyncFederation,
+    staleness_discount,
+)
 from distributed_learning_simulator_tpu.robustness.chaos import (  # noqa: F401
     InjectedCrash,
     maybe_crash,

@@ -16,8 +16,15 @@ Failure modes (``config.failure_mode``):
     excluded from aggregation (weight 0, survivors renormalized) and its
     persistent per-client state is frozen.
   * ``straggler`` — the client trains but its upload arrives after the
-    round closes: update excluded like dropout, but its local state
-    advances (it did the work; only the server missed it).
+    round closes. In a synchronous run (``async_mode='off'``, the
+    pinned default) the update is excluded like dropout — the server
+    can only wait or drop — but its local state advances (it did the
+    work; only the server missed it). With the arrival model on
+    (``async_mode='on'``, robustness/arrivals.py) the same fault means
+    "arrives after the deadline": the upload is routed into the
+    staleness buffer at a forced staleness of at least 1 and applied in
+    a later round, and the client counts as a survivor — graceful
+    degradation replacing wait-or-drop.
   * ``corrupt_nan`` — the client reports on time but its upload is
     garbage: every parameter is NaN. Keeps its aggregation weight (the
     server cannot know the payload is poison before aggregating).
@@ -95,6 +102,17 @@ class FailureModel:
         """Failed client contributes nothing to aggregation (weight 0);
         survivors are renormalized over the remaining weight."""
         return self.mode in ("dropout", "straggler")
+
+    @property
+    def routes_to_buffer(self) -> bool:
+        """Whether an active ASYNC round (robustness/arrivals.py) should
+        treat this failure as a late-but-arriving upload — forced past
+        the deadline into the staleness buffer — instead of excluding
+        it. Only ``straggler`` qualifies: its upload exists and arrives;
+        dropout never trained and the corrupt modes damage the payload,
+        not its timing. Consulted only when an AsyncFederation is
+        active, so synchronous semantics stay byte-identical."""
+        return self.mode == "straggler"
 
     @property
     def corrupts_upload(self) -> bool:

@@ -58,6 +58,9 @@ from distributed_learning_simulator_tpu.parallel.mesh import (
     replicate,
     shard_client_data,
 )
+from distributed_learning_simulator_tpu.robustness.arrivals import (
+    AsyncFederation,
+)
 from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
 from distributed_learning_simulator_tpu.telemetry import (
     ClientStats,
@@ -167,6 +170,16 @@ def lr_factors(config, start: int, k: int) -> np.ndarray:
     )
 
 
+#: Per-round async-federation scalars the round program reports in aux
+#: (robustness/arrivals.py; the carried ``async_state`` itself is popped
+#: before any record building). Fetched inside the round's single metric
+#: device_get, rendered as the schema-v4 ``async`` sub-object.
+_ASYNC_AUX_KEYS = (
+    "on_time_count", "late_count", "buffer_count", "buffer_applied",
+    "mean_staleness", "sim_duration", "sim_duration_sync", "sim_clock",
+)
+
+
 class _StackedAuxRow(Mapping):
     """Lazy per-round view of a batched dispatch's scan-stacked aux.
 
@@ -192,16 +205,21 @@ class _StackedAuxRow(Mapping):
         return len(self._aux_k)
 
 
-def _algo_checkpoint_state(algorithm, metrics, server_state) -> dict:
+def _algo_checkpoint_state(algorithm, metrics, server_state,
+                           async_state=None) -> dict:
     """Assemble the checkpoint's ``algo_state`` dict — the ONE copy shared
     by the round-loop checkpoint cadence, the batched-dispatch flush, and
     the SIGTERM force-write path (the copies were one field away from
-    drifting)."""
+    drifting). ``async_state`` is the staleness-buffer carry
+    (robustness/arrivals.py) — persisted so an async resume replays the
+    buffer bit-exactly, absent entirely for synchronous runs."""
     algo_state = {"prev_metrics": metrics}
     if hasattr(algorithm, "shapley_values"):
         algo_state["shapley_values"] = algorithm.shapley_values
     if server_state is not None:
         algo_state["server_opt_state"] = jax.device_get(server_state)
+    if async_state is not None:
+        algo_state["async_state"] = jax.device_get(async_state)
     return algo_state
 
 
@@ -476,6 +494,18 @@ def run_simulation(
             "and keep_client_params — their aux/post_round consume "
             "per-round parameter stacks); set rounds_per_dispatch=1"
         )
+    # Asynchronous federation (robustness/arrivals.py): same capability
+    # pattern as supports_round_batching — a refusal with the cause, not
+    # a silent synchronous run the user didn't ask for.
+    async_ctl = AsyncFederation.from_config(config)
+    if async_ctl is not None and not getattr(
+        algorithm, "supports_async", False
+    ):
+        raise ValueError(
+            f"algorithm {config.distributed_algorithm!r} does not support "
+            "async_mode='on': its round program has no staleness buffer "
+            "to hold late uploads; set async_mode='off'"
+        )
 
     # The raw eval fn is shared by the standalone jitted program (K=1
     # dispatches) and the batched dispatch, which fuses it into the
@@ -536,6 +566,12 @@ def run_simulation(
     key = jax.random.key(config.seed + 1)
     client_state = algorithm.init_client_state(
         optimizer, global_params, n_clients
+    )
+    # Staleness-buffer carry (async_mode='on'): one f32 param-sized
+    # accumulator + scalars, owned by the host loop like client_state —
+    # threaded into every dispatch, checkpointed, restored on resume.
+    async_state = (
+        async_ctl.init_state(global_params) if async_ctl is not None else None
     )
     if config.resume and config.checkpoint_dir:
         # Integrity-verified discovery: a corrupt/truncated latest
@@ -615,6 +651,23 @@ def run_simulation(
                             "the configuration the checkpoint was written with"
                         )
                     server_state = jax.tree_util.tree_map(jnp.asarray, saved_ss)
+            saved_async = ckpt["algo_state"].get("async_state")
+            if async_ctl is None and saved_async is not None:
+                raise ValueError(
+                    "checkpoint was written with async_mode='on' but "
+                    "async_mode='off' now (the staleness buffer would be "
+                    "silently discarded); resume with the configuration "
+                    "the checkpoint was written with"
+                )
+            if async_ctl is not None:
+                if saved_async is None:
+                    raise ValueError(
+                        "async_mode='on' but the checkpoint has no "
+                        "staleness-buffer state (written with "
+                        "async_mode='off'); resume with the configuration "
+                        "the checkpoint was written with"
+                    )
+                async_state = jax.tree_util.tree_map(jnp.asarray, saved_async)
             if ckpt.get("rng_key") is not None:
                 key = ckpt["rng_key"]
             if hasattr(algorithm, "shapley_values"):
@@ -669,6 +722,11 @@ def run_simulation(
         global_params = replicate(global_params, mesh)
         if server_state is not None:
             server_state = replicate(server_state, mesh)
+        if async_state is not None:
+            # Replicated like the global model: the buffer is server-side
+            # state, and the late-row reduction over the sharded client
+            # axis resolves to the same replicated tree on every device.
+            async_state = replicate(async_state, mesh)
         sizes = replicate(sizes, mesh)
         eval_batches = replicate(eval_batches, mesh)
         logger.info("client axis sharded over %d devices", config.mesh_devices)
@@ -728,7 +786,16 @@ def run_simulation(
     # Robustness telemetry (docs/ROBUSTNESS.md): per-round survivor counts
     # and quorum rejections, accumulated for the result dict so callers
     # (and bench.py) can't silently trade robustness for speed.
-    telemetry = {"rounds_rejected": 0, "survivor_counts": []}
+    telemetry = {
+        "rounds_rejected": 0,
+        "survivor_counts": [],
+        # Async federation (robustness/arrivals.py): simulated-clock sums
+        # (async vs the wait-for-everyone counterfactual) and the
+        # buffer-occupancy trail — the result dict's async_speedup_ratio.
+        "sim_async_s": 0.0,
+        "sim_sync_s": 0.0,
+        "buffer_occupancy": [],
+    }
     # Run telemetry (telemetry/; docs/OBSERVABILITY.md): phase timing,
     # recompile counting, HBM watermark. At the default 'off' both hooks
     # are inert and the metrics records stay in the legacy v1 layout.
@@ -832,9 +899,37 @@ def run_simulation(
                     for k, v in extras.items()
                 },
             }
+        async_rec = None
+        if "sim_duration" in fetched_tel:
+            # Deadline-round outcome (robustness/arrivals.py): the v4
+            # ``async`` sub-object. mean_staleness is meaningful only
+            # over a non-empty late batch (null keeps strict JSON).
+            n_late_rec = int(fetched_tel["late_count"])
+            async_rec = {
+                "on_time": int(fetched_tel["on_time_count"]),
+                "late": n_late_rec,
+                "buffer": int(fetched_tel["buffer_count"]),
+                "applied": bool(fetched_tel["buffer_applied"]),
+                "mean_staleness": (
+                    round(float(fetched_tel["mean_staleness"]), 4)
+                    if n_late_rec else None
+                ),
+                "sim_round_s": round(float(fetched_tel["sim_duration"]), 6),
+                "sim_round_sync_s": round(
+                    float(fetched_tel["sim_duration_sync"]), 6
+                ),
+                "sim_clock_s": round(float(fetched_tel["sim_clock"]), 6),
+            }
+            telemetry["sim_async_s"] += float(fetched_tel["sim_duration"])
+            telemetry["sim_sync_s"] += float(
+                fetched_tel["sim_duration_sync"]
+            )
+            telemetry["buffer_occupancy"].append(
+                int(fetched_tel["buffer_count"])
+            )
         tel_rec = tel_rec_fn()
-        if tel_rec is not None or cs_rec is not None:
-            record = build_round_record(record, tel_rec, cs_rec)
+        if tel_rec is not None or cs_rec is not None or async_rec is not None:
+            record = build_round_record(record, tel_rec, cs_rec, async_rec)
         history.append(record)
         if metrics_path:
             with open(metrics_path, "a") as f:
@@ -862,12 +957,13 @@ def run_simulation(
             k for k in ("client_stats", "quant_mse", "vote_agreement")
             if k in p["aux"]
         ] if cs_fetch else []
+        async_keys = [k for k in _ASYNC_AUX_KEYS if k in p["aux"]]
         with phase_timer.phase(p["round_idx"], "host_sync"), _oom_hint(
                 config, p["new_global"], n_clients,
                 site="deferred metric fetch"):
             fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
                 (p["metrics_dev"], p["mean_loss_dev"],
-                 {k: p["aux"][k] for k in tel_keys + cs_keys})
+                 {k: p["aux"][k] for k in tel_keys + cs_keys + async_keys})
             )
         metrics = {k: float(v) for k, v in fetched_metrics.items()}
         ctx = RoundContext(
@@ -935,7 +1031,10 @@ def run_simulation(
                     config.checkpoint_dir, f"round_{p['round_idx']}.ckpt"
                 ),
                 p["round_idx"], p["new_global"], p["client_state"],
-                _algo_checkpoint_state(algorithm, metrics, p["server_state"]),
+                _algo_checkpoint_state(
+                    algorithm, metrics, p["server_state"],
+                    p.get("async_state"),
+                ),
                 p["key"],
             )
             gc_checkpoints(config.checkpoint_dir, config.checkpoint_keep_last)
@@ -979,12 +1078,14 @@ def run_simulation(
             name for name in ("client_stats", "quant_mse", "vote_agreement")
             if name in aux_k
         ] if fetch_rounds else []
+        async_keys = [name for name in _ASYNC_AUX_KEYS if name in aux_k]
         with phase_timer.phase(last, "host_sync"), _oom_hint(
                 config, d["new_global"], n_clients,
                 site="deferred metric fetch"):
             fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
                 (d["metrics"], d["mean_loss"],
-                 {name: aux_k[name] for name in tel_keys + cs_keys})
+                 {name: aux_k[name]
+                  for name in tel_keys + cs_keys + async_keys})
             )
 
         def tel_rec_fn():
@@ -1024,7 +1125,7 @@ def run_simulation(
             metrics = {
                 name: float(v[i]) for name, v in fetched_metrics.items()
             }
-            row_keys = tel_keys + (
+            row_keys = tel_keys + async_keys + (
                 cs_keys if round_idx in fetch_rounds else []
             )
             tel_row = {name: fetched_tel[name][i] for name in row_keys}
@@ -1059,7 +1160,8 @@ def run_simulation(
                 os.path.join(config.checkpoint_dir, f"round_{last}.ckpt"),
                 last, d["new_global"], d["client_state"],
                 _algo_checkpoint_state(
-                    algorithm, prev_metrics, d["server_state"]
+                    algorithm, prev_metrics, d["server_state"],
+                    d.get("async_state"),
                 ),
                 d["key"],
             )
@@ -1142,7 +1244,7 @@ def run_simulation(
                         dispatch = jax.jit(
                             make_batched_round_fn(
                                 round_fn, server_update_fn, eval_fn, k,
-                                lr_active,
+                                lr_active, async_mode=async_ctl is not None,
                             ),
                             donate_argnums=(1, 2),
                         )
@@ -1157,19 +1259,31 @@ def run_simulation(
                         if lr_active else ()
                     )
                     prev_global = global_params
+                    async_kw = (
+                        {"async_state": async_state}
+                        if async_ctl is not None else {}
+                    )
                     with annotate(
                         f"fl_rounds_{round_idx}_{last_idx}"
                     ), _oom_hint(config, global_params, n_clients):
                         with phase_timer.phase(
                                 last_idx, "client_step") as _ph:
-                            (
-                                global_params, client_state, server_state,
-                                key, metrics_k, aux_k,
-                            ) = dispatch(
+                            out = dispatch(
                                 global_params, client_state, server_state,
                                 key, cx, cy, cmask, sizes, eval_batches,
-                                *lr_args,
+                                *lr_args, **async_kw,
                             )
+                            if async_ctl is not None:
+                                (
+                                    global_params, client_state,
+                                    server_state, key, metrics_k, aux_k,
+                                    async_state,
+                                ) = out
+                            else:
+                                (
+                                    global_params, client_state,
+                                    server_state, key, metrics_k, aux_k,
+                                ) = out
                             _ph.fence((global_params, metrics_k))
                     if recompile is not None:
                         recompile.attribute(last_idx)
@@ -1186,6 +1300,7 @@ def run_simulation(
                         "prev_global": prev_global,
                         "client_state": client_state,
                         "server_state": server_state,
+                        "async_state": async_state,
                         "key": key,
                     })
                     completed_round = last_idx
@@ -1229,12 +1344,22 @@ def run_simulation(
                         ) else (
                             jnp.float32(lr_factors(config, round_idx, 1)[0]),
                         )
+                        async_kw = (
+                            {"async_state": async_state}
+                            if async_ctl is not None else {}
+                        )
                         with phase_timer.phase(round_idx, "client_step") as _ph:
                             new_global, client_state, aux = round_jit(
                                 global_params, client_state, cx, cy, cmask, sizes,
-                                round_key, *lr_args,
+                                round_key, *lr_args, **async_kw,
                             )
                             _ph.fence((new_global, aux))
+                        if async_ctl is not None:
+                            # Pop the buffer carry before any record/aux
+                            # consumer sees it; it becomes the next
+                            # round's async_state operand.
+                            aux = dict(aux)
+                            async_state = aux.pop("async_state")
                         if server_update_jit is not None:
                             # When the round program carries a quorum verdict,
                             # the server optimizer must see it: a rejected
@@ -1272,6 +1397,7 @@ def run_simulation(
                         "mean_loss_dev": aux.get("mean_client_loss", np.nan),
                         "key": key,
                         "server_state": server_state,
+                        "async_state": async_state,
                     }
                     global_params = new_global
                     if pipelined:
@@ -1324,7 +1450,7 @@ def run_simulation(
                     forced_path, completed_round, global_params,
                     client_state,
                     _algo_checkpoint_state(
-                        algorithm, prev_metrics, server_state
+                        algorithm, prev_metrics, server_state, async_state
                     ),
                     key,
                 )
@@ -1382,6 +1508,28 @@ def run_simulation(
         "clients_flagged": (
             telemetry["clients_flagged"]
             if client_stats_cfg is not None else None
+        ),
+        # Async federation (robustness/arrivals.py): simulated-clock
+        # speedup of deadline rounds over the wait-for-everyone sync
+        # counterfactual, the final simulated clock, and the mean
+        # staleness-buffer occupancy — all None when async_mode='off'.
+        # The speedup ratio covers the rounds THIS process executed (a
+        # per-run measurement, like round_seconds); the clock is read
+        # from the carried buffer state, so a resumed run reports the
+        # CUMULATIVE simulated time — consistent with the sim_clock_s
+        # the records carry.
+        "async_speedup_ratio": (
+            telemetry["sim_sync_s"] / telemetry["sim_async_s"]
+            if async_ctl is not None and telemetry["sim_async_s"] > 0
+            else None
+        ),
+        "sim_clock_seconds": (
+            float(jax.device_get(async_state["clock"]))
+            if async_ctl is not None else None
+        ),
+        "mean_buffer_occupancy": (
+            float(np.mean(telemetry["buffer_occupancy"]))
+            if telemetry["buffer_occupancy"] else None
         ),
         "preempted_at": preempted_at,
     }

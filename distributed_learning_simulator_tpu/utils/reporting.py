@@ -19,12 +19,16 @@ import json
 # ``schema_version`` and the ``telemetry`` sub-object (phase_seconds,
 # compiles, peak_hbm_bytes; docs/OBSERVABILITY.md). v3 adds the
 # ``client_stats`` sub-object (per-client quantile summaries, flagged
-# ids + reasons; telemetry/client_stats.py). A record is stamped with
+# ids + reasons; telemetry/client_stats.py). v4 adds the ``async``
+# sub-object (deadline-round outcomes, staleness-buffer occupancy, the
+# simulated clock; robustness/arrivals.py). A record is stamped with
 # the LOWEST version that describes it: telemetry_level='off' keeps
-# emitting v1 byte-for-byte, and client_stats='off' keeps telemetry-only
-# records at v2 byte-for-byte — longitudinal tooling never sees a layout
-# change it didn't opt into.
-METRICS_SCHEMA_VERSION = 3
+# emitting v1 byte-for-byte, client_stats='off' keeps telemetry-only
+# records at v2 byte-for-byte, and async_mode='off' keeps records at
+# v3 or below — longitudinal tooling never sees a layout change it
+# didn't opt into.
+METRICS_SCHEMA_VERSION = 4
+_CLIENT_STATS_SCHEMA_VERSION = 3
 _TELEMETRY_ONLY_SCHEMA_VERSION = 2
 
 # bench.py output version. v1 (implicit) had no provenance; v2 stamps
@@ -62,29 +66,38 @@ _NON_PROGRAM_FIELDS = (
 
 
 def build_round_record(base: dict, telemetry: dict | None = None,
-                       client_stats: dict | None = None) -> dict:
+                       client_stats: dict | None = None,
+                       async_federation: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
-    Both sub-objects ``None`` (``telemetry_level='off'``,
-    ``client_stats='off'``) returns ``base`` unchanged — the legacy v1
-    layout, byte-identical to pre-telemetry builds. A telemetry dict
-    alone upgrades the record to v2 (``schema_version`` + the
-    ``telemetry`` sub-object — byte-identical to pre-client-stats v2
-    builds); a client_stats dict (telemetry/client_stats.py
-    ``client_stats_record``) upgrades it to v3.
+    All sub-objects ``None`` (``telemetry_level='off'``,
+    ``client_stats='off'``, ``async_mode='off'``) returns ``base``
+    unchanged — the legacy v1 layout, byte-identical to pre-telemetry
+    builds. A telemetry dict alone upgrades the record to v2
+    (``schema_version`` + the ``telemetry`` sub-object — byte-identical
+    to pre-client-stats v2 builds); a client_stats dict
+    (telemetry/client_stats.py ``client_stats_record``) upgrades it to
+    v3; an async dict (the simulator's per-round deadline/buffer
+    outcome) upgrades it to v4 under the ``"async"`` key.
     """
-    if telemetry is None and client_stats is None:
+    if telemetry is None and client_stats is None and (
+        async_federation is None
+    ):
         return base
     record = dict(base)
-    record["schema_version"] = (
-        METRICS_SCHEMA_VERSION if client_stats is not None
-        else _TELEMETRY_ONLY_SCHEMA_VERSION
-    )
+    if async_federation is not None:
+        record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif client_stats is not None:
+        record["schema_version"] = _CLIENT_STATS_SCHEMA_VERSION
+    else:
+        record["schema_version"] = _TELEMETRY_ONLY_SCHEMA_VERSION
     if telemetry is not None:
         record["telemetry"] = telemetry
     if client_stats is not None:
         record["client_stats"] = client_stats
+    if async_federation is not None:
+        record["async"] = async_federation
     return record
 
 

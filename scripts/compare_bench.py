@@ -34,7 +34,10 @@ hovers near zero, where relative changes are pure noise. The
 ``round_batch`` leg's ``amortization_ratio`` (rounds_per_dispatch
 K-vs-1 rate ratio, measured within the run) gets the same treatment:
 ``--batch-amortization-threshold`` is an absolute floor — it hovers
-near 1.0, where a relative gate would flap.
+near 1.0, where a relative gate would flap. So does the ``async``
+leg's ``async_speedup_ratio`` (simulated-clock speedup of deadline
+rounds over the sync counterfactual): ``--async-speedup-threshold``
+is an absolute floor, default 1.0.
 
 Deliberately imports nothing heavy (no jax): usable as a CI gate and
 fast enough to self-test in tier-1 (tests/test_compare_bench.py).
@@ -172,6 +175,32 @@ def batch_amortization_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def async_speedup_gate(record: dict, threshold: float) -> dict | None:
+    """In-record async-federation gate: bench.py's ``async`` leg records
+    the run's simulated-clock speedup of deadline rounds over the
+    wait-for-everyone synchronous counterfactual
+    (``async_speedup_ratio``, computed from the same arrival draws —
+    a deterministic program property). A ratio below ``threshold``
+    means deadline rounds stopped beating sync under the documented
+    80/20 population — a regression regardless of the old record.
+    Judged ABSOLUTELY like the other in-record gates (near a fixed
+    operating point, a relative gate would flap). None when the leg is
+    absent or the floor holds."""
+    ratio = get_path(record, "async.async_speedup_ratio")
+    if ratio is None or ratio >= threshold:
+        return None
+    return {
+        "metric": "async.async_speedup_ratio",
+        "description": (
+            "simulated-clock speedup of async deadline rounds vs the "
+            "sync wait-for-everyone counterfactual (>= 1.0 means async "
+            "pays)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def _fmt(entry: dict) -> str:
     rel = entry["relative_change"]
     rel_s = f"{rel:+.1%}" if rel is not None else "n/a"
@@ -201,6 +230,12 @@ def main(argv: list[str] | None = None) -> int:
                          "ratio in the NEW record's round_batch leg "
                          "(default 0.95 — batching must at least break "
                          "even, modulo run noise)")
+    ap.add_argument("--async-speedup-threshold", type=float, default=1.0,
+                    help="min tolerated simulated-clock speedup in the "
+                         "NEW record's async leg (default 1.0 — deadline "
+                         "rounds must at least match the synchronous "
+                         "counterfactual; the ratio is deterministic, "
+                         "not wall-clock noise)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable comparison as JSON")
     args = ap.parse_args(argv)
@@ -225,6 +260,7 @@ def main(argv: list[str] | None = None) -> int:
     for gate in (
         overhead_gate(new, args.stats_overhead_threshold),
         batch_amortization_gate(new, args.batch_amortization_threshold),
+        async_speedup_gate(new, args.async_speedup_threshold),
     ):
         if gate is not None:
             result["regressions"].append(gate)

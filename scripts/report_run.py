@@ -19,8 +19,10 @@ top-ops-by-time tables (same selection rule as bench.py's regression
 proxy: utils/tracing.py).
 
 Reads all metrics schemas: v1 (pre-telemetry; accuracy/timing only), v2
-(``telemetry`` sub-object), v3 (``client_stats`` sub-object — see
-docs/OBSERVABILITY.md). The only heavy import (jax, via utils.tracing)
+(``telemetry`` sub-object), v3 (``client_stats`` sub-object), v4
+(``async`` sub-object — rendered as the staleness section:
+buffer-occupancy timeline, staleness histogram, simulated-clock speedup
+vs sync; see docs/OBSERVABILITY.md). The only heavy import (jax, via utils.tracing)
 is deferred behind ``--trace``, so metrics-only reporting is instant.
 """
 
@@ -120,6 +122,68 @@ def summarize_client_health(records: list[dict]) -> dict | None:
                 "last": round(vals[-1], 6),
             }
     return health
+
+
+def summarize_async(records: list[dict]) -> dict | None:
+    """Aggregate schema-v4 ``async`` sub-objects into the staleness
+    summary: the buffer-occupancy timeline, a histogram over the
+    recorded per-round mean staleness, and the simulated-clock speedup
+    vs the synchronous wait-for-everyone counterfactual. None when no
+    record carries async data."""
+    asy = [
+        (r.get("round"), r["async"]) for r in records
+        if isinstance(r.get("async"), dict)
+    ]
+    if not asy:
+        return None
+    occupancy = [
+        {"round": rnd, "buffer": a.get("buffer"),
+         "applied": bool(a.get("applied"))}
+        for rnd, a in asy
+    ]
+    sim_async = sum(
+        a["sim_round_s"] for _, a in asy
+        if isinstance(a.get("sim_round_s"), (int, float))
+    )
+    sim_sync = sum(
+        a["sim_round_sync_s"] for _, a in asy
+        if isinstance(a.get("sim_round_sync_s"), (int, float))
+    )
+    staleness = [
+        a["mean_staleness"] for _, a in asy
+        if isinstance(a.get("mean_staleness"), (int, float))
+    ]
+    # Integer-bucket histogram over the per-round mean staleness (the
+    # records carry round means, not per-upload values — the honest
+    # granularity to histogram).
+    histogram: dict[str, int] = {}
+    for s in staleness:
+        histogram[str(int(s))] = histogram.get(str(int(s)), 0) + 1
+    clocks = [
+        a["sim_clock_s"] for _, a in asy
+        if isinstance(a.get("sim_clock_s"), (int, float))
+    ]
+    return {
+        "rounds_reported": len(asy),
+        "late_total": sum(a.get("late") or 0 for _, a in asy),
+        "on_time_total": sum(a.get("on_time") or 0 for _, a in asy),
+        "applied_rounds": sum(1 for o in occupancy if o["applied"]),
+        "occupancy_timeline": occupancy,
+        "staleness_histogram": dict(
+            sorted(histogram.items(), key=lambda kv: int(kv[0]))
+        ),
+        # Cumulative simulated clock (a resumed run's records carry the
+        # carried-over clock, so this can exceed the per-file sums).
+        "sim_clock_s": clocks[-1] if clocks else None,
+        # THESE FILE'S rounds only — the async/sync pair the speedup
+        # ratio is computed from, so the rendered numbers always
+        # reproduce the rendered ratio.
+        "sim_clock_async_s": round(sim_async, 6),
+        "sim_clock_sync_s": round(sim_sync, 6),
+        "speedup_vs_sync": (
+            round(sim_sync / sim_async, 4) if sim_async > 0 else None
+        ),
+    }
 
 
 def summarize_run(records: list[dict], trace_stats: dict | None = None,
@@ -229,6 +293,10 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     health = summarize_client_health(records)
     if health is not None:
         summary["client_health"] = health
+
+    async_summary = summarize_async(records)
+    if async_summary is not None:
+        summary["async_federation"] = async_summary
 
     if trace_stats is not None:
         summary["trace"] = trace_stats
@@ -347,6 +415,37 @@ def render_summary(summary: dict) -> list[str]:
                     f"    ... {len(loss_series) - 16} more client(s)"
                 )
 
+    if "async_federation" in summary:
+        a = summary["async_federation"]
+        lines.append(
+            f"async federation: {a['rounds_reported']} round(s), "
+            f"{a['late_total']} late / {a['on_time_total']} on-time "
+            f"upload(s), buffer applied in {a['applied_rounds']} round(s)"
+        )
+        occ = [
+            o["buffer"] for o in a["occupancy_timeline"]
+            if o["buffer"] is not None
+        ]
+        if occ:
+            lines.append(
+                f"  buffer occupancy/round: {sparkline(occ)}  "
+                f"[{min(occ)} .. {max(occ)}]"
+            )
+        if a["staleness_histogram"]:
+            total = sum(a["staleness_histogram"].values())
+            lines.append("  staleness histogram (round means):")
+            for bucket, count in a["staleness_histogram"].items():
+                bar = "#" * max(1, int(count / total * 40))
+                lines.append(f"    s={bucket:>3}: {count:>4}  {bar}")
+        if a["speedup_vs_sync"] is not None:
+            # Per-file sums on both sides: the printed pair reproduces
+            # the printed ratio even on resumed runs, whose cumulative
+            # sim_clock_s exceeds this file's rounds.
+            lines.append(
+                f"  simulated clock: {a['sim_clock_async_s']:.1f}s async "
+                f"vs {a['sim_clock_sync_s']:.1f}s sync — "
+                f"{a['speedup_vs_sync']:.2f}x speedup"
+            )
     if "trace" in summary:
         t = summary["trace"]
         lines.append(

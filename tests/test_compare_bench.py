@@ -173,6 +173,47 @@ def test_round_batch_amortization_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_async_speedup_not_relatively_tracked(cb):
+    """The async speedup sits at a fixed operating point per config —
+    like the other in-record ratios it must never be a relative TRACKED
+    metric; only the absolute in-record floor judges it."""
+    old = _record(**{"async": {"async_speedup_ratio": 7.4}})
+    new = _record(**{"async": {"async_speedup_ratio": 6.9}})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "async" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_async_speedup_self_gate(cb, tmp_path):
+    """In-record absolute floor: deadline rounds that stop beating the
+    sync wait-for-everyone counterfactual gate on the NEW record alone."""
+    assert cb.async_speedup_gate(_record(), 1.0) is None  # leg absent
+    ok = _record(**{"async": {"async_speedup_ratio": 4.2}})
+    assert cb.async_speedup_gate(ok, 1.0) is None
+    bad = _record(**{"async": {"async_speedup_ratio": 0.84}})
+    entry = cb.async_speedup_gate(bad, 1.0)
+    assert entry and entry["new"] == 0.84 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "async.async_speedup_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--async-speedup-threshold", "0.5"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_provenance_refusal(cb):
     old, new = _record(), _record()
     new["config_hash"] = "fedcba654321"

@@ -3,9 +3,9 @@
 Jax-free (imports only utils.reporting + jsonschema): the schema at
 tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle) writes through
-``build_round_record``. v1 (legacy), v2 (+telemetry) and v3
-(+client_stats) records must validate; records that mix versions and
-sub-objects inconsistently must not. The integration tests in
+``build_round_record``. v1 (legacy), v2 (+telemetry), v3
+(+client_stats) and v4 (+async) records must validate; records that mix
+versions and sub-objects inconsistently must not. The integration tests in
 test_client_stats.py validate REAL produced records against the same
 file.
 """
@@ -104,9 +104,17 @@ def test_v2_batched_dispatch_record_validates():
     validate(record)
 
 
+def _async() -> dict:
+    return {
+        "on_time": 6, "late": 2, "buffer": 5, "applied": False,
+        "mean_staleness": 1.5,
+        "sim_round_s": 1.5, "sim_round_sync_s": 11.2, "sim_clock_s": 19.5,
+    }
+
+
 def test_v3_record_validates():
     record = build_round_record(_base(), _telemetry(), _client_stats())
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 3
+    assert record["schema_version"] == 3
     validate(record)
     # client_stats without telemetry (telemetry_level='off') is still v3.
     validate(build_round_record(_base(), None, _client_stats()))
@@ -114,6 +122,20 @@ def test_v3_record_validates():
     validate(build_round_record(
         _base(), None, {"n_clients": 4, "vote_agreement": 0.93}
     ))
+
+
+def test_v4_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async()
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 4
+    validate(record)
+    # async alone (telemetry_level='off', client_stats='off') is still v4.
+    validate(build_round_record(_base(), None, None, _async()))
+    # A quiet round: nothing late -> null mean staleness.
+    validate(build_round_record(_base(), None, None, {
+        **_async(), "late": 0, "mean_staleness": None,
+    }))
 
 
 def test_version_content_mismatches_rejected():
@@ -140,6 +162,17 @@ def test_version_content_mismatches_rejected():
         validate(bad)
     bad = build_round_record(
         _base(), None, {**_client_stats(), "mystery": 1}
+    )
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v3 stamp smuggling an async sub-object (the builder always stamps
+    # async records v4).
+    bad = build_round_record(_base(), None, _client_stats())
+    bad["async"] = _async()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    bad = build_round_record(
+        _base(), None, None, {**_async(), "mystery": 1}
     )
     with pytest.raises(jsonschema.ValidationError):
         validate(bad)

@@ -193,8 +193,11 @@ def test_build_round_record_v2_layout():
     assert out["telemetry"] == tel
     assert out["round"] == 3
     v3 = build_round_record(base, tel, {"n_clients": 4})
-    assert v3["schema_version"] == METRICS_SCHEMA_VERSION == 3
+    assert v3["schema_version"] == 3
     assert v3["client_stats"] == {"n_clients": 4}
+    v4 = build_round_record(base, tel, None, {"on_time": 4})
+    assert v4["schema_version"] == METRICS_SCHEMA_VERSION == 4
+    assert v4["async"] == {"on_time": 4}
 
 
 def test_config_hash_tracks_program_knobs_only(tiny_config):
