@@ -76,6 +76,20 @@ class Algorithm:
     # False — a third-party post_round reading ctx.global_params would
     # silently get wrong values; FedAvg/SignSGD opt in.
     supports_round_batching: bool = False
+    # Whether the algorithm's round program can run under
+    # ``config.client_residency='streamed'`` (data/residency.py +
+    # parallel/streaming.py): per-client arrays live in a host shard
+    # store and the round fn takes the STREAMED calling convention —
+    # ``round_fn(global_params, state_k, x_k, y_k, m_k, part_sizes, idx,
+    # key[, lr_scale][, async_state])`` where the cohort slices are
+    # already-gathered operands and ``idx`` is the cohort's true client
+    # ids (None when the cohort is the whole population). Conservative
+    # default False — the simulator refuses with the cause; FedAvg
+    # builds the streamed program natively, sign_SGD adapts its
+    # full-population round via ``adapt_full_cohort_streamed``, the
+    # Shapley servers refuse (their subset re-evaluation assumes a
+    # resident stack).
+    supports_streamed_residency: bool = False
     # Whether the round program implements asynchronous federation
     # (config.async_mode='on'; robustness/arrivals.py): deadline rounds,
     # the staleness buffer carried as round state, and the extra
@@ -165,6 +179,41 @@ class Algorithm:
         """
         return None
 
+    # ---- streamed residency (config.client_residency='streamed') -----------
+    def cohort_indices(self, round_key, n_clients: int):
+        """Host-replay of the round program's cohort draw.
+
+        Under streamed residency the host must know WHICH clients round
+        ``round_key`` trains BEFORE dispatch (to gather their slice from
+        the shard store — and to prefetch the next dispatch's slice while
+        this one computes). The contract: given the same ``round_key``
+        the host loop hands the round program, return exactly the client
+        ids the RESIDENT program would draw in-program, as a host numpy
+        array — or None when the cohort is the whole population (no
+        sampling). The caller runs this on the CPU backend; jax PRNG
+        values are backend-deterministic, which is what makes the replay
+        exact (the PR 2/PR 6 round-key-chain discipline).
+        """
+        return None
+
+    def gather_client_state(self, store, idx):
+        """Cohort slice of the host store's persistent per-client state.
+
+        The streamed-residency mirror of the resident program's
+        in-program state gather (ops/cohort.cohort_take). The default
+        delegates to the store's numpy index math; algorithms with
+        exotic state layouts may override.
+        """
+        return store.gather_state(idx)
+
+    def scatter_client_state(self, store, idx, cohort_state) -> None:
+        """Write post-round cohort state back into the host store.
+
+        Mirror of ops/cohort.cohort_scatter; called with HOST (numpy)
+        values — the streamer fetches device state before scattering.
+        """
+        store.scatter_state(idx, cohort_state)
+
     # ---- host side ---------------------------------------------------------
     def prepare(self, apply_fn, eval_fn) -> None:
         """One-time setup after the engine is built (e.g. jit subset-eval)."""
@@ -172,3 +221,23 @@ class Algorithm:
     def post_round(self, ctx: RoundContext) -> dict:
         """Host-side per-round hook; returns extra metrics to record/log."""
         return {}
+
+
+def adapt_full_cohort_streamed(round_fn):
+    """Wrap a resident-convention round fn into the streamed convention.
+
+    For algorithms whose cohort is always the whole population
+    (sign_SGD: the per-step vote synchronizes everyone), the streamed
+    operands ARE the full arrays and the conventions differ only by the
+    ``idx`` operand — always None here — sitting before the key.
+    """
+
+    def streamed_fn(global_params, state_k, x_k, y_k, m_k, part_sizes, idx,
+                    key, *args, **kwargs):
+        assert idx is None, "full-cohort streamed round fn got a cohort index"
+        return round_fn(
+            global_params, state_k, x_k, y_k, m_k, part_sizes, key,
+            *args, **kwargs
+        )
+
+    return streamed_fn

@@ -24,6 +24,10 @@ from distributed_learning_simulator_tpu.ops.aggregate import (
     aggregate,
     weighted_mean,
 )
+from distributed_learning_simulator_tpu.ops.cohort import (
+    cohort_scatter,
+    cohort_take,
+)
 from distributed_learning_simulator_tpu.parallel.engine import (
     chunked_accumulate,
     make_local_train_fn,
@@ -48,6 +52,12 @@ class FedAvg(Algorithm):
     # (carried via the async_state operand / aux key). fed_quant inherits
     # — its payload transform applies to fresh and late uploads alike.
     supports_async = True
+    # Streamed residency (config.client_residency='streamed'): the round
+    # builder emits the streamed calling convention natively — the cohort
+    # slice arrives as already-gathered operands, the in-program gather/
+    # scatter drops out, and the shared cohort_round body keeps the two
+    # programs bit-identical. fed_quant inherits.
+    supports_streamed_residency = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -169,6 +179,28 @@ class FedAvg(Algorithm):
         broadcast). Returns (params, extra_aux)."""
         return global_params, {}
 
+    def cohort_indices(self, round_key, n_clients: int):
+        """Host-replay of the round program's cohort draw (base contract).
+
+        MUST mirror ``split_round_key`` + the in-program
+        ``jax.random.choice`` in ``make_round_fn`` exactly: part_key is
+        split index 0 of the 4-way (or, with a failure model, 5-way)
+        round-key split. The streamer runs this on the CPU backend; jax
+        PRNG draws are backend-deterministic, so the streamed cohort is
+        the resident cohort bit-for-bit.
+        """
+        cfg = self.config
+        n_participants = cfg.cohort_size(n_clients)
+        if n_participants == n_clients:
+            return None
+        n_splits = 5 if FailureModel.from_config(cfg) is not None else 4
+        part_key = jax.random.split(round_key, n_splits)[0]
+        return np.asarray(
+            jax.random.choice(
+                part_key, n_clients, (n_participants,), replace=False
+            )
+        )
+
     def make_round_fn(self, apply_fn, optimizer, n_clients: int,
                       preprocess=None, client_sizes=None):
         from distributed_learning_simulator_tpu.ops.augment import get_augment
@@ -180,6 +212,14 @@ class FedAvg(Algorithm):
         # legitimately differ; ADVICE r4).
         self.check_cohort(n_clients)
         cfg = self.config
+        # Streamed residency (config.client_residency): the builder emits
+        # the streamed calling convention — cohort slices as operands,
+        # no in-program gather/scatter — sharing cohort_round with the
+        # resident entry so the two programs cannot drift.
+        streamed = (
+            getattr(cfg, "client_residency", "resident").lower()
+            == "streamed"
+        )
         compute_dtype = None
         if getattr(cfg, "local_compute_dtype", "float32") == "bfloat16":
             compute_dtype = jnp.bfloat16
@@ -451,19 +491,18 @@ class FedAvg(Algorithm):
                     # masked slots, without the wasted scan.
                     continue
                 idx = jnp.asarray(idx_np)
-                take = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
                 trees_g = (
-                    jax.tree_util.tree_map(take, state),
-                    take(x)[:, : s * bsz],
-                    take(y)[:, : s * bsz],
-                    take(m)[:, : s * bsz],
+                    cohort_take(state, idx),
+                    cohort_take(x, idx)[:, : s * bsz],
+                    cohort_take(y, idx)[:, : s * bsz],
+                    cohort_take(m, idx)[:, : s * bsz],
                     keys[idx],
-                    take(norm_w),
+                    cohort_take(norm_w, idx),
                 )
                 if af is not None:
-                    trees_g = trees_g + (take(late_w),)
+                    trees_g = trees_g + (cohort_take(late_w, idx),)
                 if fm is not None:
-                    trees_g = trees_g + (take(failed),)
+                    trees_g = trees_g + (cohort_take(failed, idx),)
                 if idx_np.size <= chunk:
                     partial, (ns_g, tm_g) = compute(trees_g, gk)
                 else:
@@ -478,58 +517,49 @@ class FedAvg(Algorithm):
                         lambda a: jnp.zeros((n,) + a.shape[1:], a.dtype),
                         tm_g,
                     )
-                metrics_full = jax.tree_util.tree_map(
-                    lambda full, g: full.at[idx].set(g), metrics_full, tm_g
-                )
+                metrics_full = cohort_scatter(metrics_full, idx, tm_g)
                 if state is not None:
-                    new_state = jax.tree_util.tree_map(
-                        lambda full, g: full.at[idx].set(g), new_state, ns_g
-                    )
+                    new_state = cohort_scatter(new_state, idx, ns_g)
             # At least one nonzero group always ran: an all-empty cohort
             # collapses the plan to the single s=0 group, which round_fn
             # routes to the plain path (len(plan) <= 1 -> plan = None).
             assert metrics_full is not None
             return agg, new_state, metrics_full
 
-        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
-                     lr_scale=1.0, async_state=None):
-            if af is not None and async_state is None:
-                # Trace-time wiring check: the simulator owns the buffer
-                # carry; a direct caller forgetting it would otherwise
-                # train with a silently-fresh buffer every round.
-                raise ValueError(
-                    "async_mode='on' round program needs the async_state "
-                    "operand (AsyncFederation.init_state)"
-                )
+        def split_round_key(key):
+            """The round key's split chain — the ONE copy shared by the
+            resident and streamed entries AND mirrored by the host-side
+            cohort replay (FedAvg.cohort_indices), so the three can never
+            drift. The extra fault split is gated so failure-free runs
+            keep the exact pre-feature RNG streams (bit-compatible
+            histories)."""
             if fm is not None:
-                # The extra split is gated so failure-free runs keep the
-                # exact pre-feature RNG streams (bit-compatible histories).
                 part_key, train_key, payload_key, agg_key, fault_key = (
                     jax.random.split(key, 5)
                 )
-                failed = fm.draw_failed(fault_key, n_participants)
-                survival = ~failed
             else:
                 part_key, train_key, payload_key, agg_key = (
                     jax.random.split(key, 4)
                 )
+                fault_key = None
+            return part_key, train_key, payload_key, agg_key, fault_key
+
+        def cohort_round(global_params, state_k, x_k, y_k, m_k, part_sizes,
+                         idx, key, keys, lr_scale, async_state):
+            """The round body AFTER the cohort gather — shared verbatim by
+            the resident entry (which gathered in-program) and the
+            streamed entry (whose operands arrived pre-gathered from the
+            host store), which is what makes the two residency modes
+            bit-identical by construction. ``idx`` is the cohort's true
+            client ids (None = whole population); the returned
+            ``new_state_k`` is cohort-sliced and NOT yet scattered."""
+            _, train_key, payload_key, agg_key, fault_key = keys
+            if fm is not None:
+                failed = fm.draw_failed(fault_key, n_participants)
+                survival = ~failed
+            else:
                 failed = None
             client_keys = jax.random.split(train_key, n_participants)
-            idx = None
-            if n_participants == n_clients:
-                state_k, x_k, y_k, m_k = client_state, cx, cy, cmask
-                part_sizes = sizes
-            else:
-                # Client sampling: train only the sampled cohort (fixed size
-                # -> one compilation); non-participants keep their state and
-                # contribute nothing to aggregation.
-                idx = jax.random.choice(
-                    part_key, n_clients, (n_participants,), replace=False
-                )
-                take = lambda a: jnp.take(a, idx, axis=0)
-                state_k = jax.tree_util.tree_map(take, client_state)
-                x_k, y_k, m_k = take(cx), take(cy), take(cmask)
-                part_sizes = jnp.take(sizes, idx, axis=0)
             routed_late = None
             if failed is not None and fm.excludes_update:
                 if af is not None and fm.routes_to_buffer:
@@ -666,7 +696,7 @@ class FedAvg(Algorithm):
             else:
                 plan = None
                 if bucket_sizes is not None:
-                    plan = _bucket_plan(cx.shape[1] // cfg.batch_size)
+                    plan = _bucket_plan(x_k.shape[1] // cfg.batch_size)
                     if len(plan) <= 1:
                         # Uniform work: scheduling is a no-op; keep the
                         # plain path (bit-identical to scheduling-off).
@@ -784,16 +814,6 @@ class FedAvg(Algorithm):
                     "sim_duration_sync": sim_duration_sync,
                     "sim_clock": new_async_state["clock"],
                 })
-            if idx is not None:
-                # Sampled cohort indices: third-party post_round attribution
-                # and the host loop's cohort_hash resume-determinism
-                # telemetry.
-                aux["participants"] = idx
-                new_state = jax.tree_util.tree_map(
-                    lambda s, ns: s.at[idx].set(ns), client_state, new_state_k
-                )
-            else:
-                new_state = new_state_k
             aux.update({
                 "client_loss": train_metrics["loss"],
                 "client_accuracy": train_metrics["accuracy"],
@@ -801,9 +821,80 @@ class FedAvg(Algorithm):
                 **payload_aux,
                 **agg_aux,
             })
+            return new_global, new_state_k, aux
+
+        def round_fn(global_params, client_state, cx, cy, cmask, sizes, key,
+                     lr_scale=1.0, async_state=None):
+            if af is not None and async_state is None:
+                # Trace-time wiring check: the simulator owns the buffer
+                # carry; a direct caller forgetting it would otherwise
+                # train with a silently-fresh buffer every round.
+                raise ValueError(
+                    "async_mode='on' round program needs the async_state "
+                    "operand (AsyncFederation.init_state)"
+                )
+            keys = split_round_key(key)
+            idx = None
+            if n_participants == n_clients:
+                state_k, x_k, y_k, m_k = client_state, cx, cy, cmask
+                part_sizes = sizes
+            else:
+                # Client sampling: train only the sampled cohort (fixed size
+                # -> one compilation); non-participants keep their state and
+                # contribute nothing to aggregation.
+                idx = jax.random.choice(
+                    keys[0], n_clients, (n_participants,), replace=False
+                )
+                state_k = cohort_take(client_state, idx)
+                x_k, y_k, m_k = (
+                    cohort_take(cx, idx),
+                    cohort_take(cy, idx),
+                    cohort_take(cmask, idx),
+                )
+                part_sizes = cohort_take(sizes, idx)
+            new_global, new_state_k, aux = cohort_round(
+                global_params, state_k, x_k, y_k, m_k, part_sizes, idx,
+                key, keys, lr_scale, async_state,
+            )
+            if idx is not None:
+                # Sampled cohort indices: third-party post_round attribution
+                # and the host loop's cohort_hash resume-determinism
+                # telemetry.
+                aux["participants"] = idx
+                new_state = cohort_scatter(client_state, idx, new_state_k)
+            else:
+                new_state = new_state_k
             return new_global, new_state, aux
 
-        return round_fn
+        if not streamed:
+            return round_fn
+
+        def round_fn_streamed(global_params, state_k, x_k, y_k, m_k,
+                              part_sizes, idx, key, lr_scale=1.0,
+                              async_state=None):
+            """Streamed calling convention (base.Algorithm docstring): the
+            cohort slice arrives pre-gathered from the host shard store,
+            ``idx`` is its true client ids (None = whole population), and
+            the post-round cohort state is RETURNED, not scattered — the
+            streamer writes it back into the host store. The round key is
+            split exactly as in the resident program (part_key is
+            consumed by the host's cohort replay instead of an in-program
+            choice), so every downstream draw is unchanged."""
+            if af is not None and async_state is None:
+                raise ValueError(
+                    "async_mode='on' round program needs the async_state "
+                    "operand (AsyncFederation.init_state)"
+                )
+            keys = split_round_key(key)
+            new_global, new_state_k, aux = cohort_round(
+                global_params, state_k, x_k, y_k, m_k, part_sizes, idx,
+                key, keys, lr_scale, async_state,
+            )
+            if idx is not None:
+                aux["participants"] = idx
+            return new_global, new_state_k, aux
+
+        return round_fn_streamed
 
     def client_param_transform(self):
         """Param transform inside the client loss (QAT hook; None here)."""

@@ -498,6 +498,11 @@ class MultiRoundShapley(FedAvg):
     # K-stacked aux['client_params']; SV attribution needs each round's
     # stack + metrics synchronously (same reason pipelining is off).
     supports_round_batching = False
+    # Streamed residency (config.client_residency='streamed'): subset
+    # re-evaluation consumes the RESIDENT aux['client_params'] stack —
+    # overrides the FedAvg-family opt-in; the simulator refuses with
+    # the cause.
+    supports_streamed_residency = False
 
     def __init__(self, config):
         super().__init__(config)
@@ -607,6 +612,9 @@ class GTGShapley(FedAvg):
     keep_client_params = True
     supports_round_pipelining = False  # post_round consumes round metrics
     supports_round_batching = False  # same: per-round stacks + metrics
+    # Same as MultiRoundShapley: the permutation walk's subset utilities
+    # assume a resident per-client stack; streamed residency is refused.
+    supports_streamed_residency = False
 
     def __init__(self, config):
         super().__init__(config)

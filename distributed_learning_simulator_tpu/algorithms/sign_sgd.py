@@ -28,7 +28,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from distributed_learning_simulator_tpu.algorithms.base import Algorithm
+from distributed_learning_simulator_tpu.algorithms.base import (
+    Algorithm,
+    adapt_full_cohort_streamed,
+)
+from distributed_learning_simulator_tpu.ops.cohort import batched_take
 from distributed_learning_simulator_tpu.ops.sign import (
     direction_leaf,
     momentum_leaf,
@@ -54,6 +58,12 @@ class SignSGD(Algorithm):
     # accounting is a pure shape function — nothing needs per-round
     # parameter state, so K rounds scan cleanly into one dispatch.
     supports_round_batching = True
+    # Streamed residency (config.client_residency='streamed'): the
+    # per-step vote synchronizes EVERY client (the constructor rejects
+    # participation_fraction < 1), so the "cohort" is always the whole
+    # population — the round adapts to the streamed calling convention
+    # via adapt_full_cohort_streamed and the data upload happens once.
+    supports_streamed_residency = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -248,9 +258,12 @@ class SignSGD(Algorithm):
                     idx = jax.lax.dynamic_slice_in_dim(
                         perms, step * batch_size, batch_size, axis=1
                     )  # [C, B]
-                    bx = jax.vmap(lambda x, i: jnp.take(x, i, axis=0))(cx, idx)
-                    by = jax.vmap(lambda y, i: jnp.take(y, i, axis=0))(cy, idx)
-                    bm = jax.vmap(lambda m, i: jnp.take(m, i, axis=0))(cmask, idx)
+                    # Per-client minibatch gather over the client axis:
+                    # ops/cohort.batched_take, the ONE copy shared with
+                    # the FedAvg-family cohort index ops.
+                    bx = batched_take(cx, idx)
+                    by = batched_take(cy, idx)
+                    bm = batched_take(cmask, idx)
                     is_first = step_counts == 0  # [C]
 
                     if chunk is None or chunk >= n_clients:
@@ -397,6 +410,13 @@ class SignSGD(Algorithm):
             )
             return params, new_state, aux
 
+        if (
+            getattr(cfg, "client_residency", "resident").lower()
+            == "streamed"
+        ):
+            # Full-cohort streamed convention: identical program, the
+            # idx operand (always None here) absorbed by the adapter.
+            return adapt_full_cohort_streamed(round_fn)
         return round_fn
 
     def post_round(self, ctx):

@@ -297,6 +297,25 @@ class ExperimentConfig:
     # (uniform shards) or cannot apply (mesh/multihost sharding, client
     # sampling, materializing algorithms, unchunked rounds).
     bucket_client_work: bool = True
+    # Where per-client arrays (data shards + persistent algorithm state)
+    # live between rounds. "resident" (default): the full [n_clients, ...]
+    # stacks are device-resident for the whole run — the exact
+    # pre-feature program, trace-time gated like failure_mode/async_mode.
+    # "streamed": the full-N arrays live in a host-side shard store
+    # (data/residency.py) and only the sampled cohort's slice is uploaded
+    # per dispatch, with the NEXT dispatch's cohort prefetched while the
+    # current one computes (parallel/streaming.py) — device memory sizes
+    # by the cohort, not the population, which is what lets
+    # million-client populations run on one host
+    # (docs/PERFORMANCE.md § Streamed client state). Bit-identical to
+    # 'resident' at any N: the cohort index sequence is host-replayed
+    # from the round-key chain, so sampling/fault/training draws are
+    # unchanged. vmap execution only; refuses mesh/multihost sharding
+    # (the cohort slice layout would fight the PartitionSpec) and
+    # algorithms that don't opt in (Algorithm.supports_streamed_residency
+    # — the Shapley family's subset re-evaluation assumes a resident
+    # stack).
+    client_residency: str = "resident"
     # Fraction of clients sampled (without replacement) to train+aggregate
     # each round (FedAvg-family). 1.0 = all clients, the reference's fixed
     # behavior; <1.0 is standard FL client sampling — and unlike the
@@ -569,6 +588,28 @@ class ExperimentConfig:
                 f"unknown execution_mode {self.execution_mode!r}; known: "
                 "vmap, threaded"
             )
+        if self.client_residency.lower() not in ("resident", "streamed"):
+            raise ValueError(
+                f"unknown client_residency {self.client_residency!r}; "
+                "known: resident, streamed"
+            )
+        if self.client_residency.lower() == "streamed":
+            if self.execution_mode.lower() == "threaded":
+                raise ValueError(
+                    "client_residency='streamed' requires the vmap "
+                    "execution mode (the threaded oracle owns its own "
+                    "per-worker data)"
+                )
+            if self.multihost or (
+                self.mesh_devices is not None and self.mesh_devices > 1
+            ):
+                raise ValueError(
+                    "client_residency='streamed' does not compose with "
+                    "mesh/multihost sharding: the per-dispatch cohort "
+                    "upload would fight the client-axis PartitionSpec; "
+                    "use client_residency='resident' with mesh_devices, "
+                    "or streamed on a single device"
+                )
         if self.rounds_per_dispatch < 1:
             raise ValueError("rounds_per_dispatch must be >= 1")
         if (

@@ -1,0 +1,228 @@
+"""Host-side client-state residency: the full-N shard store.
+
+``config.client_residency='streamed'`` moves ownership of the per-client
+arrays (data shards + persistent algorithm state) from "device stack
+built at startup" to this host store: the full ``[n_clients, ...]``
+arrays live in host RAM, and only the sampled cohort's slice is uploaded
+to the accelerator per dispatch (parallel/streaming.py owns the upload /
+prefetch pipeline; this module owns the arrays and the index math).
+
+Deliberately jax-free: the gather/scatter index math here is the host
+mirror of ``ops/cohort.py``'s device gather/scatter, and keeping it
+importable without jax lets the unit tests (tests/test_streaming.py)
+pin the index semantics without a backend. Pytree traversal is a
+minimal local walk (dict / list / tuple / namedtuple / None) because
+per-client state trees are plain containers of arrays (optax states are
+namedtuples).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tree_map_np(fn, *trees):
+    """Minimal pytree map over dict/list/tuple/namedtuple containers.
+
+    Mirrors ``jax.tree_util.tree_map`` for the container types per-client
+    state actually uses, without importing jax. ``None`` is a leaf that
+    passes through (absent momentum buffers). All ``trees`` must share
+    structure; ``fn`` receives one leaf per tree.
+    """
+    t0 = trees[0]
+    if isinstance(t0, dict):
+        return {k: tree_map_np(fn, *(t[k] for t in trees)) for k in t0}
+    if isinstance(t0, tuple) and hasattr(t0, "_fields"):  # namedtuple
+        return type(t0)(
+            *(tree_map_np(fn, *leaves) for leaves in zip(*trees))
+        )
+    if isinstance(t0, (list, tuple)):
+        mapped = [tree_map_np(fn, *leaves) for leaves in zip(*trees)]
+        return type(t0)(mapped)
+    if t0 is None:
+        return None
+    return fn(*trees)
+
+
+def tree_leaves_np(tree) -> list:
+    """Flatten a tree (same container set as :func:`tree_map_np`) into
+    its non-None leaves."""
+    out: list = []
+
+    def walk(t):
+        if isinstance(t, dict):
+            for k in t:
+                walk(t[k])
+        elif isinstance(t, (list, tuple)):
+            for c in t:
+                walk(c)
+        elif t is not None:
+            out.append(t)
+
+    walk(tree)
+    return out
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of every array leaf in ``tree``."""
+    return sum(int(np.asarray(leaf).nbytes) for leaf in tree_leaves_np(tree))
+
+
+class HostShardStore:
+    """Full-population client arrays in host RAM, gathered per cohort.
+
+    Owns the packed data shards (``x``/``y``/``mask``/``sizes``,
+    data/partition.py layout) and, when the algorithm carries persistent
+    per-client state under participation sampling, the full-N state tree.
+    The store is the source of truth between dispatches: checkpoints read
+    it, and post-round cohort state scatters back into it.
+    """
+
+    def __init__(self, x, y, mask, sizes, state=None):
+        self.x = np.ascontiguousarray(x)
+        self.y = np.ascontiguousarray(y)
+        self.mask = np.ascontiguousarray(mask)
+        self.sizes = np.ascontiguousarray(sizes)
+        self.state = state
+        n = self.x.shape[0]
+        if not (self.y.shape[0] == self.mask.shape[0]
+                == self.sizes.shape[0] == n):
+            raise ValueError(
+                "client-axis length mismatch: "
+                f"x={n}, y={self.y.shape[0]}, mask={self.mask.shape[0]}, "
+                f"sizes={self.sizes.shape[0]}"
+            )
+        for leaf in tree_leaves_np(state):
+            if np.asarray(leaf).ndim >= 1 and np.asarray(leaf).shape[0] != n:
+                raise ValueError(
+                    "per-client state leaf has client-axis length "
+                    f"{np.asarray(leaf).shape[0]}, store has {n}"
+                )
+
+    @property
+    def n_clients(self) -> int:
+        return self.x.shape[0]
+
+    def _check_idx(self, idx: np.ndarray) -> np.ndarray:
+        idx = np.asarray(idx)
+        if idx.size and (idx.min() < 0 or idx.max() >= self.n_clients):
+            raise IndexError(
+                f"cohort index out of range [0, {self.n_clients}): "
+                f"[{idx.min()}, {idx.max()}]"
+            )
+        return idx
+
+    def gather_data(self, idx=None):
+        """Cohort slice of the data shards: ``(x, y, mask, sizes)``.
+
+        ``idx=None`` returns the full arrays (the degenerate
+        cohort-is-everyone case — no copy, the store arrays themselves).
+        """
+        if idx is None:
+            return self.x, self.y, self.mask, self.sizes
+        idx = self._check_idx(idx)
+        return (
+            np.take(self.x, idx, axis=0),
+            np.take(self.y, idx, axis=0),
+            np.take(self.mask, idx, axis=0),
+            np.take(self.sizes, idx, axis=0),
+        )
+
+    def gather_state(self, idx=None):
+        """Cohort slice of the persistent per-client state tree."""
+        if self.state is None:
+            return None
+        if idx is None:
+            return self.state
+        idx = self._check_idx(idx)
+        return tree_map_np(
+            lambda a: np.take(np.asarray(a), idx, axis=0), self.state
+        )
+
+    def scatter_state(self, idx, cohort_state) -> None:
+        """Write post-round cohort state back at rows ``idx`` (in place).
+
+        The host mirror of ``ops/cohort.cohort_scatter``: non-selected
+        rows keep their values; ``idx`` must be duplicate-free
+        (participation sampling draws without replacement). ``idx=None``
+        replaces the whole state tree.
+        """
+        if self.state is None:
+            if cohort_state is not None and tree_leaves_np(cohort_state):
+                raise ValueError(
+                    "scatter_state on a store with no per-client state"
+                )
+            return
+        if idx is None:
+            self.state = tree_map_np(np.asarray, cohort_state)
+            return
+        idx = self._check_idx(idx)
+
+        def put(full, part):
+            full = np.asarray(full)
+            full[idx] = np.asarray(part)
+            return full
+
+        self.state = tree_map_np(put, self.state, cohort_state)
+
+    def data_bytes(self) -> int:
+        """Host bytes of the full-N data shards."""
+        return (self.x.nbytes + self.y.nbytes + self.mask.nbytes
+                + self.sizes.nbytes)
+
+    def cohort_data_bytes(self, cohort: int) -> int:
+        """Device bytes of ONE uploaded cohort data slice."""
+        n = self.n_clients
+        per_client = self.data_bytes() / max(n, 1)
+        return int(per_client * min(cohort, n))
+
+    def state_bytes(self) -> int:
+        return tree_bytes(self.state)
+
+    def cohort_state_bytes(self, cohort: int) -> int:
+        n = self.n_clients
+        return int(self.state_bytes() / max(n, 1) * min(cohort, n))
+
+
+def synthetic_stream_shards(x_train, y_train, n_clients: int,
+                            shard_size: int, seed: int = 0):
+    """Vectorized synthetic ``ClientData`` for population-scale benches.
+
+    ``pack_client_shards`` walks a Python loop per client — fine at
+    thousands, minutes at a million. This draws every client's shard as
+    one fancy-index gather from a small sample pool (with replacement
+    across clients): uint8-compact layout (float32 fallback outside the
+    [0, 1] range, like pack_client_shards), full masks, identical decode
+    semantics to the packed path. The pool being small is the point —
+    the POPULATION axis is what the stream bench scales, not the
+    dataset.
+    """
+
+    from distributed_learning_simulator_tpu.data.partition import (
+        ClientData,
+        _compact_encode,
+        _unit_range,
+    )
+
+    n_pool = x_train.shape[0]
+    sample_shape = tuple(x_train.shape[1:])
+    dim = int(np.prod(sample_shape))
+    ok, _, _ = _unit_range(x_train)
+    if ok:
+        # Same range contract as pack_client_shards: uint8 encoding
+        # assumes [0, 1] inputs; out-of-range pools keep float32 (the
+        # decode path dispatches on dtype either way).
+        pool = _compact_encode(
+            x_train.reshape(n_pool, dim).astype(np.float32), n_pool, dim
+        )
+    else:
+        pool = np.asarray(x_train, dtype=np.float32)
+    rng = np.random.default_rng(seed)
+    ix = rng.integers(0, n_pool, size=(n_clients, shard_size))
+    return ClientData(
+        x=pool[ix],
+        y=np.asarray(y_train, dtype=np.int32)[ix],
+        mask=np.ones((n_clients, shard_size), dtype=np.float32),
+        sizes=np.full(n_clients, float(shard_size), dtype=np.float32),
+        sample_shape=sample_shape,
+    )

@@ -1,0 +1,55 @@
+"""Cohort gather/scatter over the client axis — the ONE copy.
+
+Every algorithm that samples, regroups, or streams clients needs the same
+three index operations over client-stacked pytrees:
+
+  * :func:`cohort_take` — gather rows of every leaf at ``idx`` (the
+    participation-sampling gather in ``fedavg.round_fn`` and the
+    size-aware scheduler's per-group slice — previously two ad-hoc
+    ``take = lambda a: jnp.take(a, idx, axis=0)`` copies);
+  * :func:`cohort_scatter` — write cohort rows back into the full stack
+    at ``idx`` (per-client state / metrics scatter);
+  * :func:`batched_take` — per-row gather ``out[c] = a[c, idx[c]]``
+    (sign_SGD's per-step minibatch gather over the client axis).
+
+Both residency modes (``config.client_residency``) go through these: the
+resident round program gathers on device, and the streamed host store
+(data/residency.py) mirrors the same index math in numpy — keeping the
+two implementations semantically paired is what the bit-identity
+contract between the modes rests on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cohort_take(tree, idx):
+    """Gather rows ``idx`` along axis 0 of every leaf of ``tree``.
+
+    ``tree`` may be a bare array or any pytree (per-client state); None
+    leaves (absent momentum buffers) pass through untouched.
+    """
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, idx, axis=0), tree)
+
+
+def cohort_scatter(tree, idx, update):
+    """Write cohort rows ``update`` back into ``tree`` at rows ``idx``.
+
+    The inverse of :func:`cohort_take` for state that persists across
+    rounds: non-selected rows keep their values. ``idx`` must be
+    duplicate-free (participation sampling draws without replacement).
+    """
+    return jax.tree_util.tree_map(
+        lambda full, part: full.at[idx].set(part), tree, update
+    )
+
+
+def batched_take(stacked, idx):
+    """Per-row gather: ``out[c] = stacked[c, idx[c]]`` for each client c.
+
+    ``stacked`` is ``[C, S, ...]``, ``idx`` is ``[C, B]``; returns
+    ``[C, B, ...]`` — each client's own minibatch rows from its own shard.
+    """
+    return jax.vmap(lambda a, i: jnp.take(a, i, axis=0))(stacked, idx)

@@ -451,6 +451,81 @@ def make_batched_round_fn(round_fn, server_update_fn, eval_fn, length: int,
     return batched
 
 
+def make_streamed_batched_round_fn(round_fn, server_update_fn, eval_fn,
+                                   length: int, lr_schedule: bool,
+                                   async_mode: bool = False):
+    """Batched dispatch for the STREAMED calling convention with a
+    sampled cohort (config.client_residency='streamed' +
+    rounds_per_dispatch > 1; parallel/streaming.py).
+
+    Mirrors :func:`make_batched_round_fn`'s scan — the same
+    ``key, round_key = jax.random.split(key)`` chain, server-optimizer
+    step, and fused eval, so K>1 streamed history is bit-identical to
+    the K=1 loop — but the per-round client data arrives PRE-GATHERED:
+    the K cohorts' slices are stacked ``[K, cohort, ...]`` scan operands
+    (uploaded by the streamer, which host-replayed this scan's key chain
+    to know the cohorts ahead of time) and each iteration consumes one
+    slice. There is no client-state carry: the simulator refuses
+    streamed batching with persistent per-client state — cohorts inside
+    one dispatch may overlap, and a scan iteration cannot scatter into
+    the host store mid-dispatch.
+
+    Returns ``batched(global_params, server_state, key, xs_k, ys_k,
+    ms_k, sizes_k, idx_k, eval_batches[, lr_vec][, async_state]) ->
+    (new_global, new_server_state, new_key, metrics_k, aux_k
+    [, async_state])``.
+    """
+
+    def batched(global_params, server_state, key, xs_k, ys_k, ms_k,
+                sizes_k, idx_k, eval_batches, lr_vec=None,
+                async_state=None):
+        def body(carry, scan_in):
+            if async_mode:
+                gp, sstate, k, astate = carry
+                kw = {"async_state": astate}
+            else:
+                gp, sstate, k = carry
+                kw = {}
+            if lr_schedule:
+                x_r, y_r, m_r, s_r, i_r, lr_k = scan_in
+            else:
+                x_r, y_r, m_r, s_r, i_r = scan_in
+            k, round_key = jax.random.split(k)
+            args = (gp, None, x_r, y_r, m_r, s_r, i_r, round_key)
+            if lr_schedule:
+                args = args + (lr_k,)
+            new_gp, _state, aux = round_fn(*args, **kw)
+            if async_mode:
+                aux = dict(aux)
+                astate = aux.pop("async_state")
+            if server_update_fn is not None:
+                srv_args = (gp, new_gp, sstate)
+                if "round_rejected" in aux:
+                    srv_args += (aux["round_rejected"],)
+                new_gp, sstate = server_update_fn(*srv_args)
+            metrics = eval_fn(new_gp, *eval_batches)
+            carry = (
+                (new_gp, sstate, k, astate) if async_mode
+                else (new_gp, sstate, k)
+            )
+            return carry, (metrics, aux)
+
+        xs = (xs_k, ys_k, ms_k, sizes_k, idx_k)
+        if lr_schedule:
+            xs = xs + (lr_vec,)
+        carry0 = (global_params, server_state, key)
+        if async_mode:
+            carry0 = carry0 + (async_state,)
+        carry_out, (metrics_k, aux_k) = jax.lax.scan(body, carry0, xs)
+        if async_mode:
+            gp, sstate, key, astate = carry_out
+            return gp, sstate, key, metrics_k, aux_k, astate
+        gp, sstate, key = carry_out
+        return gp, sstate, key, metrics_k, aux_k
+
+    return batched
+
+
 def make_reshaper(sample_shape):
     """Batch preprocess for flattened eval storage: restore sample shape.
 

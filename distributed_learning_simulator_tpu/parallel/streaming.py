@@ -1,0 +1,255 @@
+"""Double-buffered host->HBM cohort pipeline (client_residency='streamed').
+
+The resident round program keeps every per-client array device-resident
+for the whole run, so HBM sizes by the POPULATION even when
+``participation_fraction`` samples a tiny cohort. Under streamed
+residency the full-N arrays live in a host shard store
+(data/residency.py) and this module owns the transfer pipeline:
+
+  * **cohort replay** — the round program's cohort draw is re-derived
+    HOST-side from the round-key chain (``Algorithm.cohort_indices``,
+    the PR 2/PR 6 round-key discipline), so the streamer knows WHICH
+    clients a dispatch trains before it runs — no device round-trip;
+  * **upload** — the cohort's data slices are gathered from the store
+    and ``jax.device_put`` as the round program's pre-gathered operands
+    (the streamed calling convention, algorithms/base.py);
+  * **prefetch** — the NEXT dispatch's upload runs on a worker thread
+    while the current dispatch computes, so at steady state the
+    transfer cost is hidden behind compute (``overlap_ratio`` measures
+    exactly how much: hidden transfer seconds / total transfer
+    seconds);
+  * **writeback** — persistent per-client state returned by the round
+    scatters back into the host store, which is the source of truth
+    between dispatches (checkpoints read it).
+
+Every transfer is timed and byte-counted; the per-dispatch stats become
+the schema-v5 ``stream`` sub-object of the metrics record
+(utils/reporting.py) and the run totals feed the result dict's
+``stream_overlap_ratio`` (bench.py's ``stream`` leg gates it through
+scripts/compare_bench.py --stream-overlap-threshold).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import numpy as np
+
+from distributed_learning_simulator_tpu.data.residency import (
+    HostShardStore,
+    tree_bytes,
+)
+
+
+def _nbytes(arrays) -> int:
+    return sum(
+        int(np.asarray(a).nbytes) for a in arrays if a is not None
+    )
+
+
+class CohortStreamer:
+    """Owns the host shard store's device side: upload, prefetch, writeback.
+
+    One dispatch's upload is a tuple ``(x, y, m, sizes, idx)`` of device
+    arrays — cohort-shaped for a single round (``[cohort, ...]``), or
+    stacked ``[k, cohort, ...]`` for a batched dispatch
+    (config.rounds_per_dispatch > 1). ``prefetch`` schedules the upload
+    on the ONE worker thread (uploads are sequential by construction —
+    double buffering needs exactly one in flight); ``acquire`` collects
+    it, falling back to a synchronous upload when nothing (or the wrong
+    cohort — e.g. after a preemption break) is pending.
+    """
+
+    def __init__(self, store: HostShardStore, algorithm, n_clients: int,
+                 device=None):
+        self.store = store
+        self._algorithm = algorithm
+        self._n = n_clients
+        # device=None (the simulator's single-device runs) uploads
+        # UNCOMMITTED to the backend's default device — matching the
+        # resident program's jnp.asarray placement. Committedness is part
+        # of the executable cache key: a committed round-0 upload turns
+        # the round outputs committed, so round 1's params arrive with a
+        # different sharding signature than round 0's and the round
+        # program compiles twice (one spurious post-warmup compile).
+        self._device = device
+        # Cohort replay runs on the CPU backend when one exists: jax PRNG
+        # draws are backend-deterministic, and tiny eager choice/split ops
+        # must not interleave with the accelerator's round program.
+        try:
+            self._cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            self._cpu = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cohort-upload"
+        )
+        self._pending = None  # (idx_list, future) of the prefetched upload
+        # Run totals (the result dict's stream_* fields).
+        self.totals = {
+            "h2d_bytes": 0, "h2d_seconds": 0.0, "hidden_seconds": 0.0,
+            "d2h_bytes": 0, "d2h_seconds": 0.0,
+        }
+
+    # ---- cohort replay -----------------------------------------------------
+    def cohort_for(self, round_key):
+        """Host replay of the cohort the round program draws from
+        ``round_key`` (Algorithm.cohort_indices contract): a host numpy
+        index array, or None when the cohort is the whole population."""
+        if self._cpu is not None:
+            round_key = jax.device_put(round_key, self._cpu)
+        idx = self._algorithm.cohort_indices(round_key, self._n)
+        return None if idx is None else np.asarray(idx)
+
+    # ---- upload / prefetch -------------------------------------------------
+    def _upload(self, idx_list, stack: bool):
+        """Worker-thread body: gather + device_put + block, timed.
+
+        ``idx_list`` is one index array per round in the dispatch; a
+        per-round dispatch (``stack=False``, one entry) uploads
+        cohort-shaped arrays, a batched scan dispatch (``stack=True``)
+        stacks them ``[k, cohort, ...]`` — even at k=1, where the
+        remainder scan still consumes a leading round axis.
+        """
+        t0 = time.perf_counter()
+        slices = [self.store.gather_data(idx) for idx in idx_list]
+        if not stack:
+            x, y, m, s = slices[0]
+            # idx None = the whole population (upload_full): the round
+            # program's idx operand stays None too.
+            idx_arr = (
+                None if idx_list[0] is None
+                else np.asarray(idx_list[0], dtype=np.int32)
+            )
+        else:
+            x, y, m, s = (
+                np.stack([sl[j] for sl in slices]) for j in range(4)
+            )
+            idx_arr = np.stack(
+                [np.asarray(idx, dtype=np.int32) for idx in idx_list]
+            )
+        host_arrays = (x, y, m, s, idx_arr)
+        arrays = tuple(
+            None if a is None
+            else (
+                jax.device_put(a) if self._device is None
+                else jax.device_put(a, self._device)
+            )
+            for a in host_arrays
+        )
+        # device_put is asynchronous; the transfer is only DONE here —
+        # which is the point: this block runs on the worker thread, so at
+        # steady state the wait overlaps the main thread's dispatch.
+        jax.block_until_ready(arrays)
+        return arrays, _nbytes(host_arrays), time.perf_counter() - t0
+
+    def prefetch(self, idx_list, stack: bool = False) -> None:
+        """Schedule the upload for the NEXT dispatch's cohorts; returns
+        immediately. At most one prefetch is in flight (a second call
+        before acquire drains the first — the pipeline is strictly
+        double-buffered)."""
+        if self._pending is not None:
+            # Shouldn't happen in the dispatch loop's sequencing; drain
+            # rather than leak a future.
+            self._pending[2].result()
+            self._pending = None
+        self._pending = (
+            idx_list, stack, self._pool.submit(self._upload, idx_list, stack)
+        )
+
+    def acquire(self, idx_list, stack: bool = False):
+        """Collect the upload for ``idx_list``, preferring the prefetched
+        one. Returns ``((x, y, m, sizes, idx_dev), stats)`` where stats
+        is this upload's contribution to the stream record."""
+        arrays = None
+        if self._pending is not None:
+            pend_idx, pend_stack, fut = self._pending
+            self._pending = None
+            if (
+                pend_stack == stack
+                and len(pend_idx) == len(idx_list)
+                and all(
+                    np.array_equal(a, b)
+                    for a, b in zip(pend_idx, idx_list)
+                )
+            ):
+                t0 = time.perf_counter()
+                arrays, nbytes, dt = fut.result()
+                blocked = time.perf_counter() - t0
+                hidden = max(dt - blocked, 0.0)
+            else:
+                # A cohort the loop no longer wants (resume/preemption
+                # path changed the sequence): drain and re-upload. The
+                # stale transfer still moved real bytes over the bus —
+                # count it in the run totals (as unhidden time) so the
+                # accounting never under-reports traffic.
+                _, stale_bytes, stale_dt = fut.result()
+                self.totals["h2d_bytes"] += stale_bytes
+                self.totals["h2d_seconds"] += stale_dt
+        if arrays is None:
+            arrays, nbytes, dt = self._upload(idx_list, stack)
+            hidden = 0.0
+        self.totals["h2d_bytes"] += nbytes
+        self.totals["h2d_seconds"] += dt
+        self.totals["hidden_seconds"] += hidden
+        stats = {
+            "h2d_bytes": nbytes,
+            "h2d_seconds": round(dt, 6),
+            "hidden_seconds": round(hidden, 6),
+            "overlap_ratio": round(hidden / dt, 4) if dt > 0 else 0.0,
+        }
+        return arrays, stats
+
+    def upload_full(self):
+        """One-shot upload of the WHOLE population (the degenerate
+        full-cohort regime: participation_fraction >= 1, e.g. sign_SGD's
+        per-step vote over everyone). The arrays stay device-resident for
+        the run — streamed residency then only moves WHERE the startup
+        upload is accounted."""
+        arrays, nbytes, dt = self._upload([None], stack=False)
+        self.totals["h2d_bytes"] += nbytes
+        self.totals["h2d_seconds"] += dt
+        stats = {
+            "h2d_bytes": nbytes,
+            "h2d_seconds": round(dt, 6),
+            "hidden_seconds": 0.0,
+            "overlap_ratio": 0.0,
+        }
+        return arrays, stats
+
+    # ---- writeback ---------------------------------------------------------
+    def writeback(self, idx, new_state_k, stats: dict | None = None):
+        """Fetch the round's cohort state to host and scatter it into the
+        store (Algorithm.scatter_client_state). No-op for stateless
+        algorithms. ``stats`` (an acquire stats dict) grows the d2h
+        fields in place when given."""
+        if self.store.state is None:
+            return
+        t0 = time.perf_counter()
+        host_state = jax.device_get(new_state_k)
+        self._algorithm.scatter_client_state(self.store, idx, host_state)
+        dt = time.perf_counter() - t0
+        nbytes = tree_bytes(host_state)
+        self.totals["d2h_bytes"] += nbytes
+        self.totals["d2h_seconds"] += dt
+        if stats is not None:
+            stats["d2h_bytes"] = nbytes
+            stats["d2h_seconds"] = round(dt, 6)
+
+    # ---- reporting ---------------------------------------------------------
+    def overlap_ratio(self) -> float:
+        """Run-total hidden-transfer fraction: how much of the host->HBM
+        upload time the prefetch hid behind compute."""
+        total = self.totals["h2d_seconds"]
+        return self.totals["hidden_seconds"] / total if total > 0 else 0.0
+
+    def close(self) -> None:
+        if self._pending is not None:
+            # Never leak a worker-thread upload past the run.
+            try:
+                self._pending[2].result()
+            except Exception:
+                pass
+            self._pending = None
+        self._pool.shutdown(wait=True)

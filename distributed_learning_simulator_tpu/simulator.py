@@ -43,6 +43,7 @@ from distributed_learning_simulator_tpu.data.partition import (
     pack_client_shards,
 )
 from distributed_learning_simulator_tpu.data.registry import Dataset, get_dataset
+from distributed_learning_simulator_tpu.data.residency import HostShardStore
 from distributed_learning_simulator_tpu.factory import get_algorithm
 from distributed_learning_simulator_tpu.models.registry import get_model, init_params
 from distributed_learning_simulator_tpu.parallel.engine import (
@@ -51,12 +52,16 @@ from distributed_learning_simulator_tpu.parallel.engine import (
     make_eval_fn,
     make_optimizer,
     make_reshaper,
+    make_streamed_batched_round_fn,
     pad_eval_set,
 )
 from distributed_learning_simulator_tpu.parallel.mesh import (
     make_mesh,
     replicate,
     shard_client_data,
+)
+from distributed_learning_simulator_tpu.parallel.streaming import (
+    CohortStreamer,
 )
 from distributed_learning_simulator_tpu.robustness.arrivals import (
     AsyncFederation,
@@ -108,6 +113,31 @@ def _device_budget_bytes(config) -> float:
     return 0.6 * hbm * (config.mesh_devices or 1)
 
 
+def _persistent_state_factor(config) -> int:
+    """Param-sized persistent per-client buffers: one per client for
+    momentum sign_SGD or a persistent sgd optimizer, two for persistent
+    adam. The one copy shared by the chunk auto-sizer and the residency
+    feasibility check."""
+    if (
+        config.distributed_algorithm == "sign_SGD"
+        and config.momentum != 0.0
+    ):
+        return 1
+    if not config.reset_client_optimizer:
+        return 2 if config.optimizer_name.lower() in ("adam", "adamw") else 1
+    return 0
+
+
+def _resident_clients(config, n_clients: int) -> int:
+    """How many clients' persistent arrays are DEVICE-resident at once:
+    the whole population under client_residency='resident', only the
+    sampled cohort under 'streamed' (the host shard store owns the rest;
+    data/residency.py)."""
+    if config.client_residency.lower() == "streamed":
+        return config.cohort_size(n_clients)
+    return n_clients
+
+
 def _auto_chunk_size(config, global_params, n_clients: int) -> int:
     """In-flight clients from the footprint model shared with the OOM
     diagnostics (_oom_hint derives its suggestion from this function):
@@ -115,29 +145,114 @@ def _auto_chunk_size(config, global_params, n_clients: int) -> int:
     (grads + momentum + conv weight-grad temps incl. fragmentation)
     against the _device_budget_bytes budget, minus any PERSISTENT
     per-client state that is resident regardless of chunking
-    (momentum-sign_SGD buffers, non-reset client optimizer state).
+    (momentum-sign_SGD buffers, non-reset client optimizer state) — at
+    POPULATION size when resident, cohort size under streamed residency
+    (the budget the streaming layer exists to change).
     Validated on v5e: suggests ~57 for ResNet-18 x 1000 clients, inside
     the measured-safe 40-100 range."""
     param_bytes = _f32_param_bytes(global_params)
-    # Persistent (chunk-independent) per-client state: one param-sized
-    # buffer per client for momentum sign_SGD or a persistent sgd
-    # optimizer, two for persistent adam.
-    persistent_factor = 0
-    if (
-        config.distributed_algorithm == "sign_SGD"
-        and config.momentum != 0.0
-    ):
-        persistent_factor = 1
-    elif not config.reset_client_optimizer:
-        persistent_factor = (
-            2 if config.optimizer_name.lower() in ("adam", "adamw") else 1
-        )
     budget = (
         _device_budget_bytes(config)
-        - persistent_factor * n_clients * param_bytes
+        - _persistent_state_factor(config)
+        * _resident_clients(config, n_clients) * param_bytes
     )
     estimate = max(1, int(budget / (4 * param_bytes)))
     return min(estimate, config.cohort_size(n_clients))
+
+
+def _assert_residency_feasible(config, global_params, n_clients: int,
+                               data_bytes: int) -> None:
+    """Refuse clearly when the per-client arrays cannot fit the device.
+
+    Under the resident default every per-client array — the packed data
+    shards AND any persistent algorithm state — is a device-resident
+    ``[n_clients, ...]`` stack for the whole run; when that footprint
+    exceeds the budget the run used to die as an opaque allocation
+    failure deep inside the first dispatch. Name the fix instead:
+    ``client_residency='streamed'`` keeps the full-N arrays in the host
+    shard store and sizes HBM by the cohort (x2 for the double-buffered
+    prefetch), which is what this check verifies in streamed mode.
+    """
+    budget = _device_budget_bytes(config)
+    param_bytes = _f32_param_bytes(global_params)
+    factor = _persistent_state_factor(config)
+    streamed = config.client_residency.lower() == "streamed"
+    if streamed:
+        cohort = config.cohort_size(n_clients)
+        per_client_data = data_bytes / max(n_clients, 1)
+        # Sampled regime: two cohorts in flight — the computing
+        # dispatch's slice plus the prefetched next one
+        # (parallel/streaming.py double buffering). Full-cohort regime
+        # (cohort == N, e.g. sign_SGD): ONE startup upload, resident
+        # thereafter — no second buffer to budget for.
+        buffers = 2 if cohort < n_clients else 1
+        total = buffers * cohort * per_client_data + (
+            factor * cohort * param_bytes
+        )
+        if total > budget:
+            buf_note = (
+                f"{buffers} (double-buffered) x " if buffers > 1
+                else "1 (full-cohort, one startup upload) x "
+            )
+            raise ValueError(
+                "client_residency='streamed' cohort footprint does not "
+                f"fit: {buf_note}{cohort} cohort clients x "
+                f"{per_client_data / 2**20:.1f} MB data + {factor} "
+                f"param-sized state buffer(s) x {param_bytes / 2**20:.0f} "
+                f"MB = {total / 2**30:.1f} GB, over the "
+                f"~{budget / 2**30:.1f} GB device budget. Lower "
+                "participation_fraction (the cohort) or use more "
+                "mesh_devices with client_residency='resident'."
+            )
+        return
+    total = data_bytes + factor * n_clients * param_bytes
+    if total > budget:
+        state_note = (
+            f" + {factor} param-sized state buffer(s) x {n_clients} "
+            f"clients x {param_bytes / 2**20:.0f} MB"
+            if factor else ""
+        )
+        raise ValueError(
+            "client_residency='resident' keeps every per-client array "
+            f"device-resident: {data_bytes / 2**30:.1f} GB of packed "
+            f"data shards{state_note} = {total / 2**30:.1f} GB, over "
+            f"the ~{budget / 2**30:.1f} GB device budget "
+            f"({config.mesh_devices or 1} device(s)). Set "
+            "client_residency='streamed' to keep the population host-side "
+            "and stream only the sampled cohort, or use more mesh_devices."
+        )
+
+
+def _host_client_state(algorithm, optimizer, global_params, n_clients: int):
+    """Full-N per-client state on the HOST (streamed residency).
+
+    ``init_client_state`` builds a device stack — exactly what a
+    million-client run must not do. Every init in the tree is
+    per-client IDENTICAL (vmapped ``optimizer.init`` / broadcast
+    zeros), so one client's row replicated N times is the same state
+    the resident path would gather — the property the bit-identity
+    contract between the residency modes rests on.
+    """
+    proto = algorithm.init_client_state(optimizer, global_params, 1)
+    if proto is None:
+        return None
+    proto = jax.device_get(proto)
+    return jax.tree_util.tree_map(
+        lambda a: np.repeat(np.asarray(a), n_clients, axis=0), proto
+    )
+
+
+def _owned_device_tree(tree):
+    """Device-place a host tree with buffers XLA exclusively owns.
+
+    ``jnp.asarray`` of a numpy array is zero-copy on the CPU backend, so
+    feeding the result to a ``donate_argnums`` position lets XLA write
+    into (and free) memory the host side still holds — intermittent NaN
+    histories or a hard interpreter abort depending on heap layout.
+    Every host-originated tree that reaches a donated argnum (resumed
+    client/server state, streamed state gathers) must go through here.
+    """
+    return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), tree)
 
 
 def _lr_factor(config, round_idx: int) -> float:
@@ -466,6 +581,34 @@ def run_simulation(
         momentum=config.momentum, weight_decay=config.weight_decay,
     )
     algorithm = get_algorithm(config.distributed_algorithm, config)
+    # Client-state residency (config.client_residency; data/residency.py +
+    # parallel/streaming.py). 'resident' (default) keeps every per-client
+    # array device-resident — the exact pre-feature program. 'streamed'
+    # keeps the full-N arrays in a host shard store and uploads only the
+    # sampled cohort per dispatch, double-buffered so the next dispatch's
+    # cohort transfers while the current one computes.
+    streamed = config.client_residency.lower() == "streamed"
+    if streamed and not getattr(
+        algorithm, "supports_streamed_residency", False
+    ):
+        raise ValueError(
+            f"algorithm {config.distributed_algorithm!r} does not support "
+            "client_residency='streamed': its round program assumes a "
+            "device-resident per-client stack (the Shapley family's "
+            "subset re-evaluation); set client_residency='resident'"
+        )
+    cohort_n = config.cohort_size(n_clients)
+    # Sampling regime: per-dispatch cohort upload + prefetch + writeback.
+    # Full-cohort regime (participation_fraction >= 1, e.g. sign_SGD):
+    # the "cohort" is everyone — one startup upload, then the loop runs
+    # the resident program shape (HBM already sizes by the cohort).
+    stream_sampled = streamed and cohort_n < n_clients
+    stream_full = streamed and not stream_sampled
+    _assert_residency_feasible(
+        config, global_params, n_clients,
+        client_data.x.nbytes + client_data.y.nbytes
+        + client_data.mask.nbytes + client_data.sizes.nbytes,
+    )
     if algorithm.materializes_client_stack:
         _assert_client_stack_feasible(config, global_params, n_clients)
     if config.lr_schedule.lower() != "constant" and not getattr(
@@ -535,6 +678,22 @@ def run_simulation(
         model.apply, optimizer, n_clients, preprocess=preprocess,
         client_sizes=None if _sharded else client_data.sizes,
     )
+    if stream_full:
+        # Full-cohort streamed convention differs from the resident one
+        # only by the idx operand (always None — the cohort is everyone).
+        # Re-adapt so the round loop (and make_batched_round_fn) runs the
+        # SAME call shape as resident — which is what makes this regime
+        # bit-identical by construction.
+        _streamed_fn = round_fn
+
+        def round_fn(global_params, client_state, cx, cy, cmask, sizes,
+                     key, lr_scale=1.0, async_state=None):
+            kw = {} if async_state is None else {"async_state": async_state}
+            return _streamed_fn(
+                global_params, client_state, cx, cy, cmask, sizes, None,
+                key, lr_scale, **kw,
+            )
+
     round_jit = jax.jit(round_fn, donate_argnums=(1,))
 
     # Optional server-side optimizer (FedOpt; exceeds the reference): the
@@ -564,9 +723,16 @@ def run_simulation(
     start_round = 0
     prev_metrics: dict | None = None
     key = jax.random.key(config.seed + 1)
-    client_state = algorithm.init_client_state(
-        optimizer, global_params, n_clients
-    )
+    if streamed:
+        # Host-side init: the full-N state tree must never be built as a
+        # device stack (that allocation is what streamed mode removes).
+        client_state = _host_client_state(
+            algorithm, optimizer, global_params, n_clients
+        )
+    else:
+        client_state = algorithm.init_client_state(
+            optimizer, global_params, n_clients
+        )
     # Staleness-buffer carry (async_mode='on'): one f32 param-sized
     # accumulator + scalars, owned by the host loop like client_state —
     # threaded into every dispatch, checkpointed, restored on resume.
@@ -618,8 +784,14 @@ def run_simulation(
                     f"{_describe(want_cs)}; resume with the configuration "
                     "the checkpoint was written with"
                 )
-            client_state = jax.tree_util.tree_map(
-                jnp.asarray, ckpt["client_state"]
+            # Streamed residency restores into the HOST shard store
+            # (the source of truth between dispatches), not a device
+            # stack; stream_full device-places it below. Resident state
+            # is a donated round_jit operand, so it needs owned buffers.
+            client_state = (
+                jax.tree_util.tree_map(np.asarray, ckpt["client_state"])
+                if streamed
+                else _owned_device_tree(ckpt["client_state"])
             )
             start_round = ckpt["round_idx"] + 1
             prev_metrics = ckpt["algo_state"].get("prev_metrics")
@@ -650,7 +822,8 @@ def run_simulation(
                             f"{config.server_optimizer_name!r}; resume with "
                             "the configuration the checkpoint was written with"
                         )
-                    server_state = jax.tree_util.tree_map(jnp.asarray, saved_ss)
+                    # Donated by server_update_jit/batched dispatch.
+                    server_state = _owned_device_tree(saved_ss)
             saved_async = ckpt["algo_state"].get("async_state")
             if async_ctl is None and saved_async is not None:
                 raise ValueError(
@@ -704,12 +877,47 @@ def run_simulation(
 
     # --- placement ----------------------------------------------------------
     mesh = None
-    data_arrays = (
-        jnp.asarray(client_data.x), jnp.asarray(client_data.y),
-        jnp.asarray(client_data.mask),
-    )
-    sizes = jnp.asarray(client_data.sizes)
+    store = None
+    streamer = None
+    startup_stream = {"rec": None}  # stream_full's one-shot upload record
     eval_batches = tuple(jnp.asarray(a) for a in eval_batches_np)
+    if streamed:
+        # Host shard store owns the full-N arrays (data/residency.py);
+        # the streamer owns their device side (parallel/streaming.py).
+        # config.validate() already refused mesh/multihost + threaded.
+        store = HostShardStore(
+            client_data.x, client_data.y, client_data.mask,
+            client_data.sizes,
+            state=client_state if stream_sampled else None,
+        )
+        streamer = CohortStreamer(store, algorithm, n_clients)
+        if stream_full:
+            (cx, cy, cmask, sizes, _full_idx), startup_stream["rec"] = (
+                streamer.upload_full()
+            )
+            if client_state is not None:
+                # Full-cohort state lives on device across rounds exactly
+                # like resident (the whole population IS the cohort); it
+                # is a donated round_jit operand, so copy on placement.
+                client_state = _owned_device_tree(client_state)
+        else:
+            # Sampled regime: no full-N device arrays exist; the cohort
+            # slices are per-dispatch operands. The loop's client_state
+            # stays None — the store owns the state between dispatches.
+            cx = cy = cmask = None
+            sizes = jnp.asarray(client_data.sizes)
+            client_state = None
+            logger.info(
+                "client_residency='streamed': %d clients host-resident "
+                "(%.2f GB), cohort %d per dispatch",
+                n_clients, store.data_bytes() / 2**30, cohort_n,
+            )
+    else:
+        data_arrays = (
+            jnp.asarray(client_data.x), jnp.asarray(client_data.y),
+            jnp.asarray(client_data.mask),
+        )
+        sizes = jnp.asarray(client_data.sizes)
     if config.mesh_devices and config.mesh_devices > 1:
         mesh = make_mesh(config.mesh_devices)
         if n_clients % config.mesh_devices != 0:
@@ -730,7 +938,8 @@ def run_simulation(
         sizes = replicate(sizes, mesh)
         eval_batches = replicate(eval_batches, mesh)
         logger.info("client axis sharded over %d devices", config.mesh_devices)
-    cx, cy, cmask = data_arrays
+    if not streamed:
+        cx, cy, cmask = data_arrays
 
     # --- round loop ---------------------------------------------------------
     history: list[dict] = []
@@ -755,9 +964,30 @@ def run_simulation(
     # per-round fetches it absorbed), so the two modes don't compose.
     K = config.rounds_per_dispatch
     batched = K > 1
+    if batched and stream_sampled and store.state is not None:
+        # Cohorts inside one fused dispatch may overlap, and a scan
+        # iteration cannot scatter into the host store mid-dispatch —
+        # round r+1's gathered state slice would miss round r's update.
+        raise ValueError(
+            "client_residency='streamed' with rounds_per_dispatch > 1 "
+            "does not compose with persistent per-client state "
+            "(reset_client_optimizer=False / momentum sign_SGD under "
+            "sampling): cohorts within one dispatch may overlap and the "
+            "host store cannot be updated mid-dispatch; set "
+            "rounds_per_dispatch=1 or client_residency='resident'"
+        )
+    # Streamed residency with persistent per-client state: the per-round
+    # writeback (a device_get of the cohort state) already syncs every
+    # round, so a deferred metric fetch hides nothing — and a deferred
+    # finalize would checkpoint the LIVE host store after the next
+    # round's writeback mutated it.
+    stream_stateful = (
+        stream_sampled and store is not None and store.state is not None
+    )
     pipelined = (
         config.pipeline_rounds
         and not batched
+        and not stream_stateful
         and algorithm.supports_round_pipelining
         and not (
             checkpointing
@@ -771,6 +1001,11 @@ def run_simulation(
             reason = (
                 "rounds_per_dispatch > 1 already amortizes the fetch "
                 "(one device_get per dispatch)"
+            )
+        elif stream_stateful:
+            reason = (
+                "streamed residency's per-round state writeback already "
+                "syncs with the dispatch (nothing left to hide)"
             )
         elif not algorithm.supports_round_pipelining:
             reason = "the algorithm's post_round must see each round's metrics"
@@ -812,7 +1047,7 @@ def run_simulation(
     telemetry["clients_flagged"] = 0
 
     def emit_record(round_idx, metrics, fetched_loss, fetched_tel, ctx,
-                    tel_rec_fn, phase_round=None):
+                    tel_rec_fn, phase_round=None, stream_rec=None):
         """Build + persist ONE round's metrics record from already-fetched
         host values: post_round hook, record assembly, quorum/cohort
         telemetry accumulation, client-stats detection, history append +
@@ -928,8 +1163,13 @@ def run_simulation(
                 int(fetched_tel["buffer_count"])
             )
         tel_rec = tel_rec_fn()
-        if tel_rec is not None or cs_rec is not None or async_rec is not None:
-            record = build_round_record(record, tel_rec, cs_rec, async_rec)
+        if (
+            tel_rec is not None or cs_rec is not None
+            or async_rec is not None or stream_rec is not None
+        ):
+            record = build_round_record(
+                record, tel_rec, cs_rec, async_rec, stream_rec
+            )
         history.append(record)
         if metrics_path:
             with open(metrics_path, "a") as f:
@@ -1019,7 +1259,7 @@ def run_simulation(
 
         emit_record(
             p["round_idx"], metrics, fetched_loss, fetched_tel, ctx,
-            tel_rec_fn,
+            tel_rec_fn, stream_rec=p.get("stream"),
         )
 
         if (
@@ -1151,6 +1391,9 @@ def run_simulation(
                 round_idx, metrics, fetched_loss[i], tel_row, ctx,
                 tel_rec_fn if round_idx == last else (lambda: None),
                 phase_round=last,
+                # Per-DISPATCH transfer stats, on the dispatch's last
+                # record like the phase timings (docs/OBSERVABILITY.md).
+                stream_rec=d.get("stream") if round_idx == last else None,
             )
         # Dispatch sizes are clipped to checkpoint boundaries, so the
         # cadence only ever fires on the dispatch's last round — where
@@ -1214,19 +1457,43 @@ def run_simulation(
                 batched_jits: dict[int, object] = {}
                 lr_active = config.lr_schedule.lower() != "constant"
                 round_idx = start_round
-                while round_idx < config.round:
-                    k = min(K, config.round - round_idx)
-                    # Clip from the CONFIG, not `checkpointing` (which is
-                    # primary-gated): under multihost SPMD every process
-                    # must choose the same dispatch length or they run
-                    # different scan programs and the collectives desync.
-                    # Only the checkpoint WRITE is primary-only.
+
+                def _dispatch_len(start: int) -> int:
+                    """Dispatch size from ``start``: min(K, rounds
+                    remaining, distance to the next checkpoint boundary).
+                    Clipped from the CONFIG, not `checkpointing` (which
+                    is primary-gated): under multihost SPMD every
+                    process must choose the same dispatch length or they
+                    run different scan programs and the collectives
+                    desync. Only the checkpoint WRITE is primary-only."""
+                    k = min(K, config.round - start)
                     if config.checkpoint_dir and config.checkpoint_every:
                         k = min(
                             k,
                             config.checkpoint_every
-                            - (round_idx % config.checkpoint_every),
+                            - (start % config.checkpoint_every),
                         )
+                    return k
+
+                def _stream_plan(from_key, k):
+                    """Host replay of the batched scan's key chain
+                    (make_streamed_batched_round_fn does the same k
+                    ``key, round_key = split(key)`` steps): the k
+                    cohorts this dispatch trains, plus the key cursor
+                    AFTER it — which is what lets the next dispatch's
+                    cohorts prefetch before this one returns."""
+                    hk = from_key
+                    idx_list = []
+                    for _ in range(k):
+                        hk, rk = jax.random.split(hk)
+                        idx_list.append(streamer.cohort_for(rk))
+                    return idx_list, hk
+
+                # (dispatch start round, its cohort plan, key cursor
+                # after it) — prefetched while the previous dispatch ran.
+                stream_next = None
+                while round_idx < config.round:
+                    k = _dispatch_len(round_idx)
                     last_idx = round_idx + k - 1
                     if (
                         config.profile_dir
@@ -1241,13 +1508,28 @@ def run_simulation(
                         profile_from = None
                     dispatch = batched_jits.get(k)
                     if dispatch is None:
-                        dispatch = jax.jit(
-                            make_batched_round_fn(
-                                round_fn, server_update_fn, eval_fn, k,
-                                lr_active, async_mode=async_ctl is not None,
-                            ),
-                            donate_argnums=(1, 2),
-                        )
+                        if stream_sampled:
+                            # Streamed scan: the k cohorts' slices arrive
+                            # stacked [k, cohort, ...]; server_state is
+                            # operand 1 (there is no client-state carry —
+                            # refused above when state exists).
+                            dispatch = jax.jit(
+                                make_streamed_batched_round_fn(
+                                    round_fn, server_update_fn, eval_fn,
+                                    k, lr_active,
+                                    async_mode=async_ctl is not None,
+                                ),
+                                donate_argnums=(1,),
+                            )
+                        else:
+                            dispatch = jax.jit(
+                                make_batched_round_fn(
+                                    round_fn, server_update_fn, eval_fn, k,
+                                    lr_active,
+                                    async_mode=async_ctl is not None,
+                                ),
+                                donate_argnums=(1, 2),
+                            )
                         batched_jits[k] = dispatch
                     # The schedule factors become a length-k f32 operand
                     # vector (lr_factors — same values, same cast as the
@@ -1263,28 +1545,83 @@ def run_simulation(
                         {"async_state": async_state}
                         if async_ctl is not None else {}
                     )
+                    stream_rec = None
                     with annotate(
                         f"fl_rounds_{round_idx}_{last_idx}"
                     ), _oom_hint(config, global_params, n_clients):
-                        with phase_timer.phase(
-                                last_idx, "client_step") as _ph:
-                            out = dispatch(
-                                global_params, client_state, server_state,
-                                key, cx, cy, cmask, sizes, eval_batches,
-                                *lr_args, **async_kw,
-                            )
-                            if async_ctl is not None:
-                                (
-                                    global_params, client_state,
-                                    server_state, key, metrics_k, aux_k,
-                                    async_state,
-                                ) = out
+                        if stream_sampled:
+                            if (
+                                stream_next is not None
+                                and stream_next[0] == round_idx
+                            ):
+                                idx_list, hk_after = stream_next[1:]
                             else:
-                                (
+                                idx_list, hk_after = _stream_plan(key, k)
+                            (sx, sy, sm, ssz, sidx), stream_rec = (
+                                streamer.acquire(idx_list, stack=True)
+                            )
+                            if k > 1:
+                                stream_rec["dispatch_rounds"] = k
+                            with phase_timer.phase(
+                                    last_idx, "client_step") as _ph:
+                                out = dispatch(
+                                    global_params, server_state, key,
+                                    sx, sy, sm, ssz, sidx, eval_batches,
+                                    *lr_args, **async_kw,
+                                )
+                                if async_ctl is not None:
+                                    (
+                                        global_params, server_state, key,
+                                        metrics_k, aux_k, async_state,
+                                    ) = out
+                                else:
+                                    (
+                                        global_params, server_state, key,
+                                        metrics_k, aux_k,
+                                    ) = out
+                                # Prefetch the NEXT dispatch's cohorts
+                                # while this dispatch computes — BEFORE
+                                # the fence/flush syncs on its results.
+                                nxt = last_idx + 1
+                                stream_next = None
+                                if nxt < config.round and not preempt["flag"]:
+                                    k2 = _dispatch_len(nxt)
+                                    idx2, hk2 = _stream_plan(hk_after, k2)
+                                    stream_next = (nxt, idx2, hk2)
+                                    streamer.prefetch(idx2, stack=True)
+                                _ph.fence((global_params, metrics_k))
+                        else:
+                            if (
+                                stream_full
+                                and startup_stream["rec"] is not None
+                            ):
+                                # The one-shot population upload lands on
+                                # the first dispatch's record.
+                                stream_rec = startup_stream["rec"]
+                                startup_stream["rec"] = None
+                                if k > 1:
+                                    stream_rec["dispatch_rounds"] = k
+                            with phase_timer.phase(
+                                    last_idx, "client_step") as _ph:
+                                out = dispatch(
                                     global_params, client_state,
-                                    server_state, key, metrics_k, aux_k,
-                                ) = out
-                            _ph.fence((global_params, metrics_k))
+                                    server_state, key, cx, cy, cmask,
+                                    sizes, eval_batches,
+                                    *lr_args, **async_kw,
+                                )
+                                if async_ctl is not None:
+                                    (
+                                        global_params, client_state,
+                                        server_state, key, metrics_k,
+                                        aux_k, async_state,
+                                    ) = out
+                                else:
+                                    (
+                                        global_params, client_state,
+                                        server_state, key, metrics_k,
+                                        aux_k,
+                                    ) = out
+                                _ph.fence((global_params, metrics_k))
                     if recompile is not None:
                         recompile.attribute(last_idx)
                     mean_loss_k = aux_k.get("mean_client_loss")
@@ -1302,6 +1639,7 @@ def run_simulation(
                         "server_state": server_state,
                         "async_state": async_state,
                         "key": key,
+                        "stream": stream_rec,
                     })
                     completed_round = last_idx
                     round_idx = last_idx + 1
@@ -1311,6 +1649,10 @@ def run_simulation(
                         # no new dispatch is launched.
                         break
             else:
+                # Next round's host-replayed cohort (stream_sampled): the
+                # prefetched upload this index list describes is already
+                # in flight when the round that uses it starts.
+                stream_next_idx = None
                 for round_idx in range(start_round, config.round):
                     if (
                         config.profile_dir
@@ -1348,12 +1690,72 @@ def run_simulation(
                             {"async_state": async_state}
                             if async_ctl is not None else {}
                         )
-                        with phase_timer.phase(round_idx, "client_step") as _ph:
-                            new_global, client_state, aux = round_jit(
-                                global_params, client_state, cx, cy, cmask, sizes,
-                                round_key, *lr_args, **async_kw,
+                        stream_rec = None
+                        if stream_sampled:
+                            # Streamed dispatch: cohort slices arrive as
+                            # pre-gathered operands (prefetched while the
+                            # previous round computed); persistent state
+                            # gathers from the host store (post the
+                            # previous round's writeback) and scatters
+                            # back after this dispatch.
+                            idx_np = (
+                                stream_next_idx
+                                if stream_next_idx is not None
+                                else streamer.cohort_for(round_key)
                             )
-                            _ph.fence((new_global, aux))
+                            stream_next_idx = None
+                            (sx, sy, sm, ssz, sidx), stream_rec = (
+                                streamer.acquire([idx_np])
+                            )
+                            state_k = None
+                            if store.state is not None:
+                                # Donated operand: owned buffers, not a
+                                # zero-copy view of the numpy gather.
+                                state_k = _owned_device_tree(
+                                    algorithm.gather_client_state(
+                                        store, idx_np
+                                    )
+                                )
+                            with phase_timer.phase(
+                                    round_idx, "client_step") as _ph:
+                                new_global, new_state_k, aux = round_jit(
+                                    global_params, state_k, sx, sy, sm,
+                                    ssz, sidx, round_key,
+                                    *lr_args, **async_kw,
+                                )
+                                # Prefetch the next round's cohort while
+                                # this dispatch computes (the upload runs
+                                # on the streamer's worker thread).
+                                if round_idx + 1 < config.round and not (
+                                    preempt["flag"]
+                                ):
+                                    _, _nxt_rk = jax.random.split(key)
+                                    stream_next_idx = streamer.cohort_for(
+                                        _nxt_rk
+                                    )
+                                    streamer.prefetch([stream_next_idx])
+                                _ph.fence((new_global, aux))
+                            # Host store is the source of truth between
+                            # dispatches: checkpoint/resume read it.
+                            streamer.writeback(idx_np, new_state_k,
+                                               stream_rec)
+                        else:
+                            if (
+                                stream_full
+                                and startup_stream["rec"] is not None
+                            ):
+                                # One-shot population upload: recorded on
+                                # the first round's record.
+                                stream_rec = startup_stream["rec"]
+                                startup_stream["rec"] = None
+                            with phase_timer.phase(
+                                    round_idx, "client_step") as _ph:
+                                new_global, client_state, aux = round_jit(
+                                    global_params, client_state, cx, cy,
+                                    cmask, sizes,
+                                    round_key, *lr_args, **async_kw,
+                                )
+                                _ph.fence((new_global, aux))
                         if async_ctl is not None:
                             # Pop the buffer carry before any record/aux
                             # consumer sees it; it becomes the next
@@ -1391,13 +1793,19 @@ def run_simulation(
                         "round_idx": round_idx,
                         "new_global": new_global,
                         "prev_global": global_params,
-                        "client_state": None if pipelined else client_state,
+                        # Sampled streamed: the (post-writeback) host
+                        # store is what a checkpoint must persist.
+                        "client_state": (
+                            store.state if stream_sampled
+                            else None if pipelined else client_state
+                        ),
                         "aux": aux,
                         "metrics_dev": metrics_dev,
                         "mean_loss_dev": aux.get("mean_client_loss", np.nan),
                         "key": key,
                         "server_state": server_state,
                         "async_state": async_state,
+                        "stream": stream_rec,
                     }
                     global_params = new_global
                     if pipelined:
@@ -1418,6 +1826,11 @@ def run_simulation(
         finally:
             if sigterm_installed:
                 signal.signal(signal.SIGTERM, prev_sigterm)
+            if streamer is not None:
+                # Join the worker thread (an in-flight prefetch must not
+                # outlive the run) — the store keeps its state for the
+                # checkpoint/result paths below.
+                streamer.close()
             if pending is not None:
                 # Crash-flush of the last deferred round. Best-effort: if
                 # finalize itself is what failed in-loop (full disk, post_round
@@ -1448,7 +1861,7 @@ def run_simulation(
             if not os.path.exists(forced_path):
                 save_checkpoint(
                     forced_path, completed_round, global_params,
-                    client_state,
+                    store.state if stream_sampled else client_state,
                     _algo_checkpoint_state(
                         algorithm, prev_metrics, server_state, async_state
                     ),
@@ -1480,7 +1893,7 @@ def run_simulation(
     )
     return {
         "global_params": global_params,
-        "client_state": client_state,
+        "client_state": store.state if stream_sampled else client_state,
         "history": history,
         "algorithm": algorithm,
         "final_accuracy": history[-1]["test_accuracy"] if history else None,
@@ -1530,6 +1943,21 @@ def run_simulation(
         "mean_buffer_occupancy": (
             float(np.mean(telemetry["buffer_occupancy"]))
             if telemetry["buffer_occupancy"] else None
+        ),
+        # Streamed residency (parallel/streaming.py): run-total transfer
+        # accounting and the fraction of host->HBM upload time the
+        # double-buffered prefetch hid behind compute — the number
+        # bench.py's `stream` leg records and compare_bench.py gates
+        # (--stream-overlap-threshold). All None when resident.
+        "client_residency": config.client_residency,
+        "stream_overlap_ratio": (
+            streamer.overlap_ratio() if streamer is not None else None
+        ),
+        "stream_h2d_bytes": (
+            streamer.totals["h2d_bytes"] if streamer is not None else None
+        ),
+        "stream_d2h_bytes": (
+            streamer.totals["d2h_bytes"] if streamer is not None else None
         ),
         "preempted_at": preempted_at,
     }

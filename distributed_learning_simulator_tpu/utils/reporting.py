@@ -21,13 +21,17 @@ import json
 # ``client_stats`` sub-object (per-client quantile summaries, flagged
 # ids + reasons; telemetry/client_stats.py). v4 adds the ``async``
 # sub-object (deadline-round outcomes, staleness-buffer occupancy, the
-# simulated clock; robustness/arrivals.py). A record is stamped with
-# the LOWEST version that describes it: telemetry_level='off' keeps
-# emitting v1 byte-for-byte, client_stats='off' keeps telemetry-only
-# records at v2 byte-for-byte, and async_mode='off' keeps records at
-# v3 or below — longitudinal tooling never sees a layout change it
-# didn't opt into.
-METRICS_SCHEMA_VERSION = 4
+# simulated clock; robustness/arrivals.py). v5 adds the ``stream``
+# sub-object (per-dispatch host<->HBM transfer bytes/seconds and the
+# prefetch overlap ratio; client_residency='streamed',
+# parallel/streaming.py). A record is stamped with the LOWEST version
+# that describes it: telemetry_level='off' keeps emitting v1
+# byte-for-byte, client_stats='off' keeps telemetry-only records at v2
+# byte-for-byte, async_mode='off' keeps records at v3 or below, and
+# client_residency='resident' keeps records at v4 or below —
+# longitudinal tooling never sees a layout change it didn't opt into.
+METRICS_SCHEMA_VERSION = 5
+_ASYNC_SCHEMA_VERSION = 4
 _CLIENT_STATS_SCHEMA_VERSION = 3
 _TELEMETRY_ONLY_SCHEMA_VERSION = 2
 
@@ -67,27 +71,33 @@ _NON_PROGRAM_FIELDS = (
 
 def build_round_record(base: dict, telemetry: dict | None = None,
                        client_stats: dict | None = None,
-                       async_federation: dict | None = None) -> dict:
+                       async_federation: dict | None = None,
+                       stream: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
     All sub-objects ``None`` (``telemetry_level='off'``,
-    ``client_stats='off'``, ``async_mode='off'``) returns ``base``
-    unchanged — the legacy v1 layout, byte-identical to pre-telemetry
-    builds. A telemetry dict alone upgrades the record to v2
-    (``schema_version`` + the ``telemetry`` sub-object — byte-identical
-    to pre-client-stats v2 builds); a client_stats dict
-    (telemetry/client_stats.py ``client_stats_record``) upgrades it to
-    v3; an async dict (the simulator's per-round deadline/buffer
-    outcome) upgrades it to v4 under the ``"async"`` key.
+    ``client_stats='off'``, ``async_mode='off'``,
+    ``client_residency='resident'``) returns ``base`` unchanged — the
+    legacy v1 layout, byte-identical to pre-telemetry builds. A
+    telemetry dict alone upgrades the record to v2 (``schema_version``
+    + the ``telemetry`` sub-object — byte-identical to pre-client-stats
+    v2 builds); a client_stats dict (telemetry/client_stats.py
+    ``client_stats_record``) upgrades it to v3; an async dict (the
+    simulator's per-round deadline/buffer outcome) upgrades it to v4
+    under the ``"async"`` key; a stream dict (the streamer's
+    per-dispatch transfer stats, parallel/streaming.py) upgrades it to
+    v5 under the ``"stream"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
-    ):
+    ) and stream is None:
         return base
     record = dict(base)
-    if async_federation is not None:
+    if stream is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif async_federation is not None:
+        record["schema_version"] = _ASYNC_SCHEMA_VERSION
     elif client_stats is not None:
         record["schema_version"] = _CLIENT_STATS_SCHEMA_VERSION
     else:
@@ -98,6 +108,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["client_stats"] = client_stats
     if async_federation is not None:
         record["async"] = async_federation
+    if stream is not None:
+        record["stream"] = stream
     return record
 
 

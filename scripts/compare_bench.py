@@ -37,7 +37,10 @@ K-vs-1 rate ratio, measured within the run) gets the same treatment:
 near 1.0, where a relative gate would flap. So does the ``async``
 leg's ``async_speedup_ratio`` (simulated-clock speedup of deadline
 rounds over the sync counterfactual): ``--async-speedup-threshold``
-is an absolute floor, default 1.0.
+is an absolute floor, default 1.0. And the ``stream`` leg's prefetch
+``overlap_ratio`` (fraction of host->HBM upload time hidden behind
+compute at the largest swept population, client_residency='streamed'):
+``--stream-overlap-threshold`` is an absolute floor, default 0.5.
 
 Deliberately imports nothing heavy (no jax): usable as a CI gate and
 fast enough to self-test in tier-1 (tests/test_compare_bench.py).
@@ -201,6 +204,31 @@ def async_speedup_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def stream_overlap_gate(record: dict, threshold: float) -> dict | None:
+    """In-record streamed-residency gate: bench.py's ``stream`` leg
+    records, at its largest swept population, the fraction of host->HBM
+    cohort-upload time the double-buffered prefetch hid behind compute
+    (``overlap_ratio``, parallel/streaming.py). A ratio below
+    ``threshold`` means the prefetch stopped overlapping — per-dispatch
+    transfers have gone synchronous and the streamed mode's cost model
+    no longer holds. Judged ABSOLUTELY like the other in-record gates
+    (the ratio sits near a fixed operating point, where a relative gate
+    would flap). None when the leg is absent or the floor holds."""
+    ratio = get_path(record, "stream.overlap_ratio")
+    if ratio is None or ratio >= threshold:
+        return None
+    return {
+        "metric": "stream.overlap_ratio",
+        "description": (
+            "fraction of streamed-residency host->HBM upload time hidden "
+            "behind compute at the largest swept population (prefetch "
+            "must overlap)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def _fmt(entry: dict) -> str:
     rel = entry["relative_change"]
     rel_s = f"{rel:+.1%}" if rel is not None else "n/a"
@@ -236,6 +264,11 @@ def main(argv: list[str] | None = None) -> int:
                          "rounds must at least match the synchronous "
                          "counterfactual; the ratio is deterministic, "
                          "not wall-clock noise)")
+    ap.add_argument("--stream-overlap-threshold", type=float, default=0.5,
+                    help="min tolerated prefetch overlap ratio in the NEW "
+                         "record's stream leg at its largest population "
+                         "(default 0.5 — at least half the host->HBM "
+                         "upload time must hide behind compute)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable comparison as JSON")
     args = ap.parse_args(argv)
@@ -261,6 +294,7 @@ def main(argv: list[str] | None = None) -> int:
         overhead_gate(new, args.stats_overhead_threshold),
         batch_amortization_gate(new, args.batch_amortization_threshold),
         async_speedup_gate(new, args.async_speedup_threshold),
+        stream_overlap_gate(new, args.stream_overlap_threshold),
     ):
         if gate is not None:
             result["regressions"].append(gate)

@@ -22,7 +22,10 @@ Reads all metrics schemas: v1 (pre-telemetry; accuracy/timing only), v2
 (``telemetry`` sub-object), v3 (``client_stats`` sub-object), v4
 (``async`` sub-object — rendered as the staleness section:
 buffer-occupancy timeline, staleness histogram, simulated-clock speedup
-vs sync; see docs/OBSERVABILITY.md). The only heavy import (jax, via utils.tracing)
+vs sync; see docs/OBSERVABILITY.md), v5 (``stream`` sub-object —
+rendered as an h2d transfer row under the phase table plus run-total
+transfer accounting; client_residency='streamed',
+docs/PERFORMANCE.md § Streamed client state). The only heavy import (jax, via utils.tracing)
 is deferred behind ``--trace``, so metrics-only reporting is instant.
 """
 
@@ -290,6 +293,24 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
                  if tel.get("peak_hbm_bytes")]
         summary["peak_hbm_bytes"] = max(peaks) if peaks else None
 
+    # --- stream sub-objects (schema v5, client_residency='streamed') --------
+    streams = [r["stream"] for r in records
+               if isinstance(r.get("stream"), dict)]
+    if streams:
+        h2d_s = sum(s.get("h2d_seconds", 0.0) for s in streams)
+        hidden_s = sum(s.get("hidden_seconds", 0.0) for s in streams)
+        summary["stream"] = {
+            "uploads": len(streams),
+            "h2d_bytes": sum(s.get("h2d_bytes", 0) for s in streams),
+            "h2d_seconds": round(h2d_s, 4),
+            "hidden_seconds": round(hidden_s, 4),
+            "overlap_ratio": round(hidden_s / h2d_s, 4) if h2d_s else 0.0,
+            "d2h_bytes": sum(s.get("d2h_bytes", 0) for s in streams),
+            "d2h_seconds": round(
+                sum(s.get("d2h_seconds", 0.0) for s in streams), 4
+            ),
+        }
+
     health = summarize_client_health(records)
     if health is not None:
         summary["client_health"] = health
@@ -348,6 +369,26 @@ def render_summary(summary: dict) -> list[str]:
                 f"  {name:<12} {st['mean_s']:>9.4f}s  "
                 f"{st['share']:>6.1%}  {bar}"
             )
+    if "stream" in summary:
+        # The host->HBM transfer row (client_residency='streamed'): kept
+        # visually with the phase table, but NOT a share of phased time —
+        # the prefetch's point is that this time overlaps client_step.
+        s = summary["stream"]
+        per_upload = s["h2d_seconds"] / max(s["uploads"], 1)
+        bar = "#" * max(1, int(s["overlap_ratio"] * 40))
+        lines.append(
+            f"  {'h2d_stream':<12} {per_upload:>9.4f}s  "
+            f"{s['overlap_ratio']:>6.1%} hidden  {bar}"
+        )
+        lines.append(
+            f"  streamed transfers: {s['uploads']} upload(s), "
+            f"{s['h2d_bytes'] / 2**20:.1f} MiB h2d"
+            + (
+                f", {s['d2h_bytes'] / 2**20:.1f} MiB d2h "
+                f"({s['d2h_seconds']:.3f}s state writeback)"
+                if s["d2h_bytes"] else ""
+            )
+        )
     if "compiles" in summary:
         c = summary["compiles"]
         lines.append(

@@ -214,6 +214,48 @@ def test_async_speedup_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_stream_overlap_not_relatively_tracked(cb):
+    """The prefetch overlap ratio sits near a fixed operating point —
+    like the other in-record ratios it must never be a relative TRACKED
+    metric; only the absolute in-record floor judges it."""
+    old = _record(stream={"overlap_ratio": 0.97})
+    new = _record(stream={"overlap_ratio": 0.90})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "stream" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_stream_overlap_self_gate(cb, tmp_path):
+    """In-record absolute floor: a streamed-residency prefetch that
+    stops hiding the host->HBM upload behind compute gates on the NEW
+    record alone."""
+    assert cb.stream_overlap_gate(_record(), 0.5) is None  # leg absent
+    ok = _record(stream={"overlap_ratio": 0.93})
+    assert cb.stream_overlap_gate(ok, 0.5) is None
+    bad = _record(stream={"overlap_ratio": 0.12})
+    entry = cb.stream_overlap_gate(bad, 0.5)
+    assert entry and entry["new"] == 0.12 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "stream.overlap_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--stream-overlap-threshold", "0.05"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_provenance_refusal(cb):
     old, new = _record(), _record()
     new["config_hash"] = "fedcba654321"

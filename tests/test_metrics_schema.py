@@ -4,10 +4,10 @@ Jax-free (imports only utils.reporting + jsonschema): the schema at
 tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
-(+client_stats) and v4 (+async) records must validate; records that mix
-versions and sub-objects inconsistently must not. The integration tests in
-test_client_stats.py validate REAL produced records against the same
-file.
+(+client_stats), v4 (+async) and v5 (+stream) records must validate;
+records that mix versions and sub-objects inconsistently must not. The
+integration tests in test_client_stats.py validate REAL produced
+records against the same file.
 """
 
 import json
@@ -128,13 +128,38 @@ def test_v4_record_validates():
     record = build_round_record(
         _base(), _telemetry(), _client_stats(), _async()
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 4
+    assert record["schema_version"] == 4
     validate(record)
     # async alone (telemetry_level='off', client_stats='off') is still v4.
     validate(build_round_record(_base(), None, None, _async()))
     # A quiet round: nothing late -> null mean staleness.
     validate(build_round_record(_base(), None, None, {
         **_async(), "late": 0, "mean_staleness": None,
+    }))
+
+
+def _stream() -> dict:
+    return {
+        "h2d_bytes": 655360, "h2d_seconds": 0.0123,
+        "hidden_seconds": 0.0119, "overlap_ratio": 0.9675,
+        "d2h_bytes": 1024, "d2h_seconds": 0.0004,
+    }
+
+
+def test_v5_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async(), _stream()
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 5
+    validate(record)
+    # stream alone (every other feature off) is still v5.
+    validate(build_round_record(_base(), None, None, None, _stream()))
+    # Stateless runs carry no d2h fields; batched dispatches stamp the
+    # rounds their transfer covers.
+    validate(build_round_record(_base(), None, None, None, {
+        "h2d_bytes": 655360, "h2d_seconds": 0.0123,
+        "hidden_seconds": 0.0, "overlap_ratio": 0.0,
+        "dispatch_rounds": 4,
     }))
 
 
@@ -173,6 +198,17 @@ def test_version_content_mismatches_rejected():
         validate(bad)
     bad = build_round_record(
         _base(), None, None, {**_async(), "mystery": 1}
+    )
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v4 stamp smuggling a stream sub-object (the builder always stamps
+    # stream records v5).
+    bad = build_round_record(_base(), None, None, _async())
+    bad["stream"] = _stream()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    bad = build_round_record(
+        _base(), None, None, None, {**_stream(), "mystery": 1}
     )
     with pytest.raises(jsonschema.ValidationError):
         validate(bad)

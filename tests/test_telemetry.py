@@ -196,8 +196,11 @@ def test_build_round_record_v2_layout():
     assert v3["schema_version"] == 3
     assert v3["client_stats"] == {"n_clients": 4}
     v4 = build_round_record(base, tel, None, {"on_time": 4})
-    assert v4["schema_version"] == METRICS_SCHEMA_VERSION == 4
+    assert v4["schema_version"] == 4
     assert v4["async"] == {"on_time": 4}
+    v5 = build_round_record(base, tel, None, None, {"h2d_bytes": 8})
+    assert v5["schema_version"] == METRICS_SCHEMA_VERSION == 5
+    assert v5["stream"] == {"h2d_bytes": 8}
 
 
 def test_config_hash_tracks_program_knobs_only(tiny_config):
