@@ -55,7 +55,15 @@ synthetic populations (10k -> 1M by default) under
 state) recording per-N cohort rates and the prefetch
 ``overlap_ratio`` — compare_bench.py gates the largest N's ratio
 absolutely (--stream-overlap-threshold); BENCH_STREAM=0 skips,
-BENCH_STREAM_SWEEP/_COHORT/_SHARD/_ROUNDS set the sweep.
+BENCH_STREAM_SWEEP/_COHORT/_SHARD/_ROUNDS set the sweep. The
+``costmodel`` sub-object (telemetry/costmodel.py) evaluates the proxy
+legs' categorized op ledgers through the roofline model: predicted
+per-round time for every topology-table entry, per-category bottleneck
+attribution, a >= v4-32 pod projection with $/converged-run, and
+``model_error_ratio`` (predicted vs this run's measured median) —
+gated absolutely by compare_bench.py (--model-drift-threshold);
+BENCH_COSTMODEL=0 skips, BENCH_COSTMODEL_TOPOLOGY sets the anchor,
+BENCH_COSTMODEL_RUN_ROUNDS the $/run horizon.
 """
 
 from __future__ import annotations
@@ -107,23 +115,49 @@ def _proxy_stats(config, dataset, client_data, rounds: int = 3) -> dict:
     ``trace_rounds`` reports the rounds the trace actually covers
     (``rounds`` minus any ``profile_from_round`` warm-up rounds the
     config excludes to keep compile host events out of the profiler
-    buffer)."""
+    buffer). ``categories`` breaks the same totals down by HLO op class
+    (utils/tracing.categorize_ops — matmul/conv, elementwise,
+    copy/layout, collective, decode), each as deterministic as the
+    grand total, so CATEGORY drift (a lost conv fusion turning into
+    elementwise+copy traffic at constant total bytes) is visible across
+    BENCH files; ``collective_gb`` surfaces the cross-chip volume the
+    cost model charges to ICI (zero on single-chip traces)."""
     import dataclasses
     import tempfile
 
+    from distributed_learning_simulator_tpu.telemetry.costmodel import (
+        ledger_totals,
+    )
     from distributed_learning_simulator_tpu.utils.tracing import (
-        parse_device_trace,
+        categorize_ops,
     )
 
     with tempfile.TemporaryDirectory() as td:
         p_config = dataclasses.replace(config, round=rounds, profile_dir=td)
         _run(p_config, dataset=dataset, client_data=client_data)
-        stats = parse_device_trace(td)
+        # One gzip pass: the ledger's totals reconcile exactly with
+        # parse_device_trace (pinned by tests/test_tracing.py), so the
+        # headline proxy numbers derive from it instead of a second
+        # scan of the ~128k-op flagship trace.
+        ledger = categorize_ops(td)
+        stats = ledger_totals(ledger)
     return {
         "traced_bytes_gb": round(stats["bytes_gb"], 3),
         "traced_device_ms": round(stats["device_ms"], 1),
         "traced_op_count": stats["op_count"],
         "trace_rounds": rounds - getattr(config, "profile_from_round", 0),
+        "categories": {
+            cat: {
+                "bytes_gb": round(entry["bytes_gb"], 3),
+                "device_ms": round(entry["device_ms"], 1),
+                "flops_g": round(entry["flops_g"], 1),
+                "op_count": entry["op_count"],
+            }
+            for cat, entry in sorted(ledger.items())
+        },
+        "collective_gb": round(
+            ledger.get("collective", {}).get("bytes_gb", 0.0), 3
+        ),
     }
 
 
@@ -592,6 +626,72 @@ def main():
             record["proxy_flagship"] = {
                 "error": (out.stderr or out.stdout)[-400:],
             }
+
+    # Predictive cost model (ISSUE 8, telemetry/costmodel.py): evaluate
+    # the proxy legs' categorized ledgers through the roofline model —
+    # predicted per-round time per topology-table entry, bottleneck
+    # attribution, $/converged-run — anchored on BENCH_COSTMODEL_TOPOLOGY
+    # (default v5e-1, the measured chip class; docs/PERFORMANCE.md
+    # § Predicted pod-scale cost). model_error_ratio (anchor-predicted /
+    # this run's measured median round) is gated ABSOLUTELY by
+    # scripts/compare_bench.py --model-drift-threshold as a band around
+    # 1.0 — the in-record pattern of the other ratio gates: the model is
+    # refit deliberately, never by silent drift. BENCH_COSTMODEL=0
+    # skips; BENCH_COSTMODEL_RUN_ROUNDS sets the $/run horizon.
+    run_cost = (
+        os.environ.get("BENCH_COSTMODEL", "1") != "0"
+        and isinstance(record.get("proxy"), dict)
+        and record["proxy"].get("categories")
+    )
+    if run_cost:
+        from distributed_learning_simulator_tpu.telemetry.costmodel import (
+            CONVERGED_RUN_ROUNDS,
+            DEFAULT_ANCHOR,
+            costmodel_record,
+            ledger_totals,
+        )
+
+        anchor = os.environ.get("BENCH_COSTMODEL_TOPOLOGY", DEFAULT_ANCHOR)
+        cm_rounds = int(os.environ.get(
+            "BENCH_COSTMODEL_RUN_ROUNDS", str(CONVERGED_RUN_ROUNDS)
+        ))
+
+        def _cm(proxy: dict, measured_ms: float) -> dict:
+            if ledger_totals(proxy["categories"])["bytes_gb"] <= 0:
+                # CPU traces carry no raw_bytes_accessed: a zero-byte
+                # ledger predicts nothing — degrade, don't fabricate.
+                return {"error": "trace carries no byte annotations"}
+            return costmodel_record(
+                proxy["categories"], trace_rounds=proxy["trace_rounds"],
+                anchor=anchor, measured_ms=measured_ms,
+                run_rounds=cm_rounds,
+            )
+
+        record["costmodel"] = {
+            "cnn": _cm(record["proxy"], r["round_ms"]["median"]),
+        }
+        fl_proxy = record.get("proxy_flagship")
+        if (
+            isinstance(fl_proxy, dict) and fl_proxy.get("categories")
+            and "flagship" in record
+        ):
+            cm_fl = _cm(fl_proxy, record["flagship"]["round_ms"]["median"])
+            record["costmodel"]["flagship"] = cm_fl
+            pod = (cm_fl.get("per_topology") or {}).get("v4-32")
+            if pod:
+                # The acceptance projection: the flagship config priced
+                # at pod scale before a single v4 chip-hour is spent.
+                record["costmodel"]["pod_projection"] = {
+                    "program": "flagship",
+                    "topology": "v4-32",
+                    "run_rounds": cm_rounds,
+                    "predicted_round_ms": pod["predicted_ms"],
+                    "chip_hours_per_run": round(
+                        pod["predicted_ms"] / 3.6e6 * pod["chips"]
+                        * cm_rounds, 4
+                    ),
+                    "usd_per_run": pod.get("usd_per_run"),
+                }
 
     print(json.dumps(record))
 

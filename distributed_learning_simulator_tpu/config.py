@@ -395,6 +395,28 @@ class ExperimentConfig:
     # tunneled chips (simulator.py run loop); bench.py's flagship proxy
     # traces from round 1.
     profile_from_round: int = 0
+    # --- predictive cost model (telemetry/costmodel.py) ---------------------
+    # Path to an EXISTING jax.profiler trace directory of this program
+    # (a previous run's profile_dir; bench.py's proxy uses its own traced
+    # run in-process). When set, the categorized op ledger
+    # (utils/tracing.categorize_ops) is evaluated through the roofline
+    # model against the checked-in topology table and the run's LAST
+    # metrics record carries the schema-v6 ``costmodel`` sub-object —
+    # predicted per-round time per topology, bottleneck attribution, and
+    # model_error_ratio against this run's measured steady round time
+    # (docs/OBSERVABILITY.md § Cost model). None (default): records stay
+    # at schema v5 or below byte-for-byte. Pure host-side analysis — it
+    # never touches the compiled program, so all three knobs are
+    # excluded from config_hash like profile_dir.
+    cost_model_trace: str | None = None
+    # Rounds the reference trace covers (bench.py's cnn proxy traces 3
+    # rounds, its flagship proxy 1): ledger totals are divided by this
+    # to get the per-round basis the prediction uses.
+    cost_model_trace_rounds: int = 1
+    # Topology-table entry (telemetry/topologies.py) the prediction is
+    # anchored on — the hardware this run's measured round time comes
+    # from; model_error_ratio is predicted-vs-measured on this entry.
+    cost_model_topology: str = "v5e-1"
     # Persistent XLA compilation cache directory: the round program's
     # ~20-45s first compile is skipped on any later run with the same
     # shapes (including across processes). Disable with None, or from the
@@ -435,6 +457,13 @@ class ExperimentConfig:
             raise ValueError("participation_fraction must be in (0, 1]")
         if self.compilation_cache_dir in ("", "none", "None"):
             self.compilation_cache_dir = None
+        if self.cost_model_trace_rounds < 1:
+            raise ValueError("cost_model_trace_rounds must be >= 1")
+        from distributed_learning_simulator_tpu.telemetry.topologies import (
+            get_topology,
+        )
+
+        get_topology(self.cost_model_topology)  # fail fast on typos
         if not isinstance(self.model_args, dict):
             raise ValueError(
                 "model_args must be a dict of model-constructor kwargs "
@@ -745,7 +774,8 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
                         "checkpoint_keep_last"):
             parser.add_argument(arg, type=int, default=None)
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
-                        "profile_dir", "client_chunk_size", "max_shard_size",
+                        "profile_dir", "cost_model_trace",
+                        "client_chunk_size", "max_shard_size",
                         "coordinator_address"):
             typ = {
                 "round_trunc_threshold": float,

@@ -70,8 +70,10 @@ from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
 from distributed_learning_simulator_tpu.telemetry import (
     ClientStats,
     RecompileMonitor,
+    costmodel_record,
     detect_and_record,
     hbm_limit_bytes,
+    ledger_totals,
     log_round_compiles,
     make_phase_timer,
     peak_hbm_bytes,
@@ -92,6 +94,7 @@ from distributed_learning_simulator_tpu.utils.logging import (
 )
 from distributed_learning_simulator_tpu.utils.tracing import (
     annotate,
+    categorize_ops,
     profile_session,
 )
 
@@ -1045,6 +1048,25 @@ def run_simulation(
     # the schema-v3 record. None at the default 'off'.
     client_stats_cfg = ClientStats.from_config(config)
     telemetry["clients_flagged"] = 0
+    # Predictive cost model (telemetry/costmodel.py): parse the reference
+    # trace ONCE at startup (pure host-side gzip read); the roofline
+    # prediction attaches to the run's LAST metrics record (schema v6)
+    # with this run's measured steady round time as the anchor. None at
+    # the default cost_model_trace=None — records stay at v5 or below.
+    cost_ledger = None
+    if config.cost_model_trace:
+        cost_ledger = categorize_ops(config.cost_model_trace)
+        if not cost_ledger or ledger_totals(cost_ledger)["bytes_gb"] <= 0:
+            # Same degrade rule as bench.py's costmodel leg: CPU traces
+            # carry no raw_bytes_accessed, and a zero-byte ledger
+            # predicts nothing — warn, never fabricate a $0 record.
+            logger.warning(
+                "cost_model_trace %r holds no byte-annotated device-op "
+                "events; cost model disabled for this run",
+                config.cost_model_trace,
+            )
+            cost_ledger = None
+    telemetry["costmodel"] = None
 
     def emit_record(round_idx, metrics, fetched_loss, fetched_tel, ctx,
                     tel_rec_fn, phase_round=None, stream_rec=None):
@@ -1162,13 +1184,34 @@ def run_simulation(
             telemetry["buffer_occupancy"].append(
                 int(fetched_tel["buffer_count"])
             )
+        cm_rec = None
+        if cost_ledger is not None and round_idx == config.round - 1:
+            # The run's measured per-round wall, averaged over the steady
+            # rounds (round 0 carries compile; under batched dispatch a
+            # dispatch's wall lands on its first round, so the MEAN over
+            # steady rounds — elapsed/rounds — is the honest unit in
+            # every dispatch shape).
+            walls = [h["round_seconds"] for h in history] + [
+                record["round_seconds"]
+            ]
+            steady = walls[1:] or walls
+            cm_rec = costmodel_record(
+                cost_ledger,
+                trace_rounds=config.cost_model_trace_rounds,
+                anchor=config.cost_model_topology,
+                measured_ms=1e3 * sum(steady) / len(steady),
+                param_bytes=_f32_param_bytes(global_params),
+                run_rounds=config.round,
+            )
+            telemetry["costmodel"] = cm_rec
         tel_rec = tel_rec_fn()
         if (
             tel_rec is not None or cs_rec is not None
             or async_rec is not None or stream_rec is not None
+            or cm_rec is not None
         ):
             record = build_round_record(
-                record, tel_rec, cs_rec, async_rec, stream_rec
+                record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec
             )
         history.append(record)
         if metrics_path:
@@ -1959,6 +2002,11 @@ def run_simulation(
         "stream_d2h_bytes": (
             streamer.totals["d2h_bytes"] if streamer is not None else None
         ),
+        # Predictive cost model (telemetry/costmodel.py): the schema-v6
+        # costmodel sub-object the run's last record carried — None when
+        # cost_model_trace is unset, the trace was empty, or the run was
+        # preempted before its last round.
+        "costmodel": telemetry["costmodel"],
         "preempted_at": preempted_at,
     }
 

@@ -23,6 +23,14 @@ engine) reports through:
   no materialized per-client stack), a host-side median/MAD anomaly
   detector attributing which clients drove or corrupted a round, and
   the ``client_stats`` sub-object of the schema-v3 metrics record.
+* :mod:`.costmodel` + :mod:`.topologies` — the predictive roofline
+  cost model: the categorized traced-op ledger
+  (utils/tracing.categorize_ops) evaluated against a checked-in
+  topology table to predict per-round device time, per-category
+  bottleneck attribution, and $/converged-run on pods the program has
+  never touched — the ``costmodel`` sub-object of the schema-v6
+  metrics record, the bench ``costmodel`` leg, and compare_bench's
+  model-vs-measured drift gate (docs/OBSERVABILITY.md § Cost model).
 
 Records land in ``metrics.jsonl`` through the schema-versioned builder in
 ``utils/reporting.py``; ``scripts/report_run.py`` renders an artifacts
@@ -42,6 +50,14 @@ from distributed_learning_simulator_tpu.telemetry.client_stats import (
     detect_and_record,
     detect_anomalies,
 )
+from distributed_learning_simulator_tpu.telemetry.costmodel import (
+    CONVERGED_RUN_ROUNDS,
+    DEFAULT_ANCHOR,
+    DEFAULT_EFFICIENCY,
+    costmodel_record,
+    ledger_totals,
+    predict_round,
+)
 from distributed_learning_simulator_tpu.telemetry.memory import (
     device_memory_stats,
     hbm_limit_bytes,
@@ -56,23 +72,37 @@ from distributed_learning_simulator_tpu.telemetry.recompile import (
     RecompileMonitor,
     log_round_compiles,
 )
+from distributed_learning_simulator_tpu.telemetry.topologies import (
+    TOPOLOGIES,
+    Topology,
+    get_topology,
+)
 
 __all__ = [
     "CLIENT_STATS_LEVELS",
+    "CONVERGED_RUN_ROUNDS",
+    "DEFAULT_ANCHOR",
+    "DEFAULT_EFFICIENCY",
     "PER_CLIENT_CAP",
     "STAT_FIELDS",
     "TELEMETRY_LEVELS",
+    "TOPOLOGIES",
     "ClientStats",
     "NullPhaseTimer",
     "PhaseTimer",
     "RecompileMonitor",
+    "Topology",
     "attribution_crosscheck",
     "client_stats_record",
+    "costmodel_record",
     "detect_and_record",
     "detect_anomalies",
     "device_memory_stats",
+    "get_topology",
     "hbm_limit_bytes",
+    "ledger_totals",
     "log_round_compiles",
     "make_phase_timer",
     "peak_hbm_bytes",
+    "predict_round",
 ]

@@ -1,0 +1,228 @@
+"""Predictive TPU cost model: roofline analysis of the traced-op ledger.
+
+The bench proxy (utils/tracing.parse_device_trace) already captures a
+bit-identical per-round op stream — 136.4 GB for the cnn headline,
+1249.0 GB for the flagship ResNet program (BENCH_r05). This module turns
+that change DETECTOR into a PREDICTOR (ROADMAP item 5, SCALE-Sim-style):
+evaluate the categorized ledger (utils/tracing.categorize_ops) against
+the checked-in topology table (telemetry/topologies.py) to predict
+per-round device time, attribute the bottleneck per op category
+(compute- vs memory- vs collective-bound), and price a converged run in
+chip-hours/USD on hardware the program has never touched.
+
+Model, per category ``c`` on topology ``T`` with ``n`` chips:
+
+    t_c = max( flops_c / (n * peak_flops * E_mxu),
+               bytes_c / (n * hbm_bw    * E_hbm) )      [roofline]
+    t_collective = collective_bytes / n / (ici_bw * E_ici)
+    predicted_round = sum_c t_c + t_collective (+ all-reduce estimate)
+
+The division by ``n`` encodes this repo's scaling mode: the client axis
+shards data-parallel across the mesh (parallel/mesh.py), so per-chip
+byte/FLOP volume divides while the global-model all-reduce (estimated
+as ``2 * param_bytes * (n-1)/n`` when ``param_bytes`` is given — the
+traced single-chip ledger contains no collectives) rides the ICI term.
+This is an OPTIMISTIC linear-scaling bound at small per-chip cohorts;
+the fitted error band in docs/PERFORMANCE.md § Predicted pod-scale cost
+is the honest calibration record.
+
+``DEFAULT_EFFICIENCY`` holds the fitted fractions of datasheet peak the
+measured programs actually reach (fit procedure + residuals documented
+in docs/PERFORMANCE.md). The model predicts DEVICE time; the cnn
+headline's wall-clock carries a ~28% host-side share on top
+(docs/PERFORMANCE.md § Round batching), which is exactly the
+systematic under-prediction the drift gate's band must cover
+(scripts/compare_bench.py --model-drift-threshold).
+
+Deliberately jax-free: the ledger is a plain dict, so
+scripts/compare_bench.py-style offline tooling and the tier-1 tests
+(tests/test_costmodel.py) evaluate the model without touching a device.
+"""
+
+from __future__ import annotations
+
+from distributed_learning_simulator_tpu.telemetry.topologies import (
+    TOPOLOGIES,
+    Topology,
+    get_topology,
+)
+
+GIB = 2**30
+
+# Fitted fractions of datasheet peak (docs/PERFORMANCE.md § Predicted
+# pod-scale cost). "hbm" is fitted on the flagship program (the robust
+# ±0.2% wall-clock signal): 1249.0 GiB / 2.2754 s measured = 589 GB/s
+# effective on a v5e-class chip = 0.72 of the 819 GB/s datasheet peak.
+# "mxu" reflects the measured in-context fusion rate (~95 TF/s mega-
+# fusions / 197 peak ~ 0.5; the isolated 8192^3 matmul reaches 0.91).
+# "ici" is a nominal large-message collective efficiency; no traced
+# collective volume exists yet to fit it (single-chip traces), so it is
+# a documented placeholder until a multi-chip trace lands.
+DEFAULT_EFFICIENCY = {"mxu": 0.50, "hbm": 0.72, "ici": 0.70}
+
+# The topology the repo's measured rounds come from (the anchor row the
+# model is validated against): a v5e-class single chip
+# (docs/PERFORMANCE.md microbenchmarks).
+DEFAULT_ANCHOR = "v5e-1"
+
+# The documented converged-run horizon (150-round flagship trajectories,
+# docs/PERFORMANCE.md § Converged flagship runs): the default rounds
+# count behind "$/converged-run" projections.
+CONVERGED_RUN_ROUNDS = 150
+
+
+def ledger_totals(ledger: dict) -> dict:
+    """Summed ``{"bytes_gb", "flops_g", "device_ms", "op_count"}`` over a
+    categorized ledger (zeros for an empty one)."""
+    out = {"bytes_gb": 0.0, "flops_g": 0.0, "device_ms": 0.0, "op_count": 0}
+    for entry in ledger.values():
+        for key in out:
+            out[key] += entry.get(key, 0)
+    return out
+
+
+def predict_round(ledger: dict, topology: Topology | str, *,
+                  trace_rounds: int = 1, efficiency: dict | None = None,
+                  param_bytes: int | None = None) -> dict:
+    """Roofline-predicted per-round device time of ``ledger`` on
+    ``topology``.
+
+    ``ledger`` maps category -> ``{"bytes_gb", "flops_g", ...}`` as
+    built by utils/tracing.categorize_ops over a trace covering
+    ``trace_rounds`` rounds (totals are divided down to one round).
+    Returns ``{"predicted_ms", "bottleneck", "categories"}`` where each
+    category carries its own ``predicted_ms`` + ``bottleneck`` and the
+    top-level bottleneck is the largest summed term
+    (compute/memory/collective).
+    """
+    if isinstance(topology, str):
+        topology = get_topology(topology)
+    if trace_rounds < 1:
+        raise ValueError(f"trace_rounds must be >= 1, got {trace_rounds}")
+    eff = {**DEFAULT_EFFICIENCY, **(efficiency or {})}
+    n = topology.chips
+    flops_rate = n * topology.peak_tflops * 1e12 * eff["mxu"]
+    hbm_rate = n * topology.hbm_gbps * 1e9 * eff["hbm"]
+    ici_rate = topology.ici_gbps * 1e9 * eff["ici"]  # per chip
+
+    categories: dict[str, dict] = {}
+    terms = {"compute": 0.0, "memory": 0.0, "collective": 0.0}
+    total_s = 0.0
+    for cat in sorted(ledger):
+        entry = ledger[cat]
+        nbytes = entry.get("bytes_gb", 0.0) * GIB / trace_rounds
+        flops = entry.get("flops_g", 0.0) * 1e9 / trace_rounds
+        if cat == "collective" and n > 1 and ici_rate > 0:
+            # Traced collective volume is per-program; each chip moves
+            # its 1/n share over its own ICI links.
+            t = nbytes / n / ici_rate
+            bound = "collective"
+        else:
+            t_compute = flops / flops_rate if flops_rate > 0 else 0.0
+            t_memory = nbytes / hbm_rate if hbm_rate > 0 else 0.0
+            t = max(t_compute, t_memory)
+            bound = "compute" if t_compute > t_memory else "memory"
+        terms[bound] += t
+        total_s += t
+        categories[cat] = {
+            "predicted_ms": t * 1e3,
+            "bottleneck": bound,
+        }
+    if param_bytes and n > 1 and ici_rate > 0:
+        # FedAvg global-model exchange per round, absent from single-chip
+        # traces: ring all-reduce volume 2 * params * (n-1)/n per chip.
+        t_allreduce = 2.0 * param_bytes * (n - 1) / n / ici_rate
+        terms["collective"] += t_allreduce
+        total_s += t_allreduce
+    bottleneck = max(terms, key=lambda k: terms[k]) if any(
+        terms.values()
+    ) else "memory"
+    return {
+        "predicted_ms": total_s * 1e3,
+        "bottleneck": bottleneck,
+        "categories": categories,
+    }
+
+
+def costmodel_record(ledger: dict, *, trace_rounds: int = 1,
+                     anchor: str = DEFAULT_ANCHOR,
+                     measured_ms: float | None = None,
+                     topologies: dict | None = None,
+                     efficiency: dict | None = None,
+                     param_bytes: int | None = None,
+                     run_rounds: int | None = None) -> dict:
+    """The schema-v6 ``costmodel`` sub-object (ONE shape shared by the
+    bench ``costmodel`` leg, the simulator's last-round metrics record,
+    and scripts/report_run.py's "cost at scale" section — pinned by
+    tests/data/metrics_record.schema.json).
+
+    ``anchor`` names the topology the run was MEASURED on;
+    ``model_error_ratio`` = anchor-predicted / measured per-round ms —
+    the number compare_bench.py's ``--model-drift-threshold`` judges as
+    an absolute band around 1.0. ``run_rounds`` (converged-run horizon)
+    adds ``usd_per_run`` per topology.
+    """
+    topos = topologies if topologies is not None else TOPOLOGIES
+    anchor_topo = (
+        topos[anchor] if anchor in topos else get_topology(anchor)
+    )
+    anchor_pred = predict_round(
+        ledger, anchor_topo, trace_rounds=trace_rounds,
+        efficiency=efficiency, param_bytes=param_bytes,
+    )
+    per_topology = {}
+    for name in sorted(topos):
+        topo = topos[name]
+        pred = (
+            anchor_pred if name == anchor else predict_round(
+                ledger, topo, trace_rounds=trace_rounds,
+                efficiency=efficiency, param_bytes=param_bytes,
+            )
+        )
+        entry = {
+            "chips": topo.chips,
+            "predicted_ms": round(pred["predicted_ms"], 3),
+            "bottleneck": pred["bottleneck"],
+            "usd_per_round": round(
+                pred["predicted_ms"] / 3.6e6
+                * topo.chips * topo.usd_per_chip_hour, 6
+            ),
+        }
+        if run_rounds:
+            entry["usd_per_run"] = round(
+                entry["usd_per_round"] * run_rounds, 4
+            )
+        per_topology[name] = entry
+    record = {
+        "anchor_topology": anchor_topo.name,
+        "predicted_ms": round(anchor_pred["predicted_ms"], 3),
+        "measured_ms": (
+            round(measured_ms, 3) if measured_ms is not None else None
+        ),
+        "model_error_ratio": (
+            round(anchor_pred["predicted_ms"] / measured_ms, 4)
+            if measured_ms else None
+        ),
+        "bottleneck": anchor_pred["bottleneck"],
+        "trace_rounds": trace_rounds,
+        "categories": {
+            cat: {
+                "bytes_gb": round(
+                    ledger[cat].get("bytes_gb", 0.0) / trace_rounds, 3
+                ),
+                "device_ms": round(
+                    ledger[cat].get("device_ms", 0.0) / trace_rounds, 2
+                ),
+                "flops_g": round(
+                    ledger[cat].get("flops_g", 0.0) / trace_rounds, 2
+                ),
+                "predicted_ms": round(pred_c["predicted_ms"], 3),
+                "bottleneck": pred_c["bottleneck"],
+            }
+            for cat, pred_c in anchor_pred["categories"].items()
+        },
+        "per_topology": per_topology,
+    }
+    if run_rounds:
+        record["run_rounds"] = run_rounds
+    return record

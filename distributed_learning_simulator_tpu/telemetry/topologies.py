@@ -1,0 +1,74 @@
+"""Checked-in TPU topology table for the predictive cost model.
+
+The roofline model (telemetry/costmodel.py) prices a traced-op ledger
+against hardware it has never run on; this module is the ONE place those
+hardware constants live, so adding a topology is a one-line table edit
+(docs/OBSERVABILITY.md § Cost model — "how to add a topology").
+
+Numbers are NOMINAL datasheet peaks (per chip): bf16 MXU TFLOP/s, HBM
+GB/s (SI), aggregate off-chip ICI GB/s, and an on-demand USD price per
+chip-hour. Real programs reach a measured FRACTION of these peaks — the
+fitted efficiency factors in costmodel.DEFAULT_EFFICIENCY, calibrated
+against this repo's measured single-chip rounds (docs/PERFORMANCE.md
+§ Predicted pod-scale cost) — so the table itself never needs
+"derating"; keep it at datasheet values.
+
+``cpu-host`` models the CI / dev-box fallback (virtual CPU mesh): a
+self-hosted host priced at zero, present so predictions degrade
+gracefully rather than KeyError when no accelerator topology applies.
+
+This module is deliberately jax-free (importable by offline tooling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """One hardware configuration the cost model can price.
+
+    ``peak_tflops`` / ``hbm_gbps`` / ``ici_gbps`` are PER-CHIP peaks;
+    ``chips`` is the data-parallel width the client axis shards over.
+    ``ici_gbps=0`` means no interconnect (single chip / host) — the
+    model then refuses to charge collective volume to ICI.
+    """
+
+    name: str
+    chips: int
+    peak_tflops: float      # bf16 MXU peak, TFLOP/s per chip
+    hbm_gbps: float         # HBM bandwidth, GB/s (SI) per chip
+    ici_gbps: float         # aggregate off-chip ICI, GB/s per chip
+    usd_per_chip_hour: float
+
+
+_TABLE = (
+    # Dev/CI host: DDR-class bandwidth, priced free (self-hosted).
+    Topology("cpu-host", 1, 1.0, 40.0, 0.0, 0.0),
+    # v5e: 197 bf16 TFLOP/s, 819 GB/s HBM — the single-chip class this
+    # repo's measured rounds come from (docs/PERFORMANCE.md micro-
+    # benchmarks: 180 TF/s matmul, ~660 GB/s streaming peak observed).
+    Topology("v5e-1", 1, 197.0, 819.0, 0.0, 1.20),
+    Topology("v5e-8", 8, 197.0, 819.0, 200.0, 1.20),
+    # v4: 275 bf16 TFLOP/s, 1228 GB/s HBM per chip.
+    Topology("v4-8", 8, 275.0, 1228.0, 300.0, 3.22),
+    Topology("v4-32", 32, 275.0, 1228.0, 300.0, 3.22),
+    Topology("v4-128", 128, 275.0, 1228.0, 300.0, 3.22),
+)
+
+TOPOLOGIES: dict[str, Topology] = {t.name: t for t in _TABLE}
+
+
+def get_topology(name: str) -> Topology:
+    """Table lookup with an actionable error (the config knob
+    ``cost_model_topology`` and bench's BENCH_COSTMODEL_TOPOLOGY both
+    resolve through here)."""
+    try:
+        return TOPOLOGIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown topology {name!r}; known: "
+            + ", ".join(sorted(TOPOLOGIES))
+            + " (add entries in telemetry/topologies.py)"
+        ) from None

@@ -24,13 +24,19 @@ import json
 # simulated clock; robustness/arrivals.py). v5 adds the ``stream``
 # sub-object (per-dispatch host<->HBM transfer bytes/seconds and the
 # prefetch overlap ratio; client_residency='streamed',
-# parallel/streaming.py). A record is stamped with the LOWEST version
-# that describes it: telemetry_level='off' keeps emitting v1
-# byte-for-byte, client_stats='off' keeps telemetry-only records at v2
-# byte-for-byte, async_mode='off' keeps records at v3 or below, and
-# client_residency='resident' keeps records at v4 or below —
-# longitudinal tooling never sees a layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 5
+# parallel/streaming.py). v6 adds the ``costmodel`` sub-object (the
+# roofline cost model's per-topology round-time/cost prediction with
+# model-vs-measured error ratio; telemetry/costmodel.py — attached to
+# the run's LAST record when config.cost_model_trace is set). A record
+# is stamped with the LOWEST version that describes it:
+# telemetry_level='off' keeps emitting v1 byte-for-byte,
+# client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
+# async_mode='off' keeps records at v3 or below, client_residency=
+# 'resident' keeps records at v4 or below, and cost_model_trace=None
+# keeps records at v5 or below — longitudinal tooling never sees a
+# layout change it didn't opt into.
+METRICS_SCHEMA_VERSION = 6
+_STREAM_SCHEMA_VERSION = 5
 _ASYNC_SCHEMA_VERSION = 4
 _CLIENT_STATS_SCHEMA_VERSION = 3
 _TELEMETRY_ONLY_SCHEMA_VERSION = 2
@@ -61,6 +67,13 @@ _NON_PROGRAM_FIELDS = (
     "compilation_cache_dir",
     "profile_dir",
     "profile_from_round",
+    # Cost-model knobs (telemetry/costmodel.py): pure host-side analysis
+    # of an already-captured trace — never touches the compiled program
+    # or any measured cost, so pricing a run must not make it
+    # incomparable to an unpriced one.
+    "cost_model_trace",
+    "cost_model_trace_rounds",
+    "cost_model_topology",
     "checkpoint_dir",
     "checkpoint_every",
     "checkpoint_keep_last",
@@ -72,7 +85,8 @@ _NON_PROGRAM_FIELDS = (
 def build_round_record(base: dict, telemetry: dict | None = None,
                        client_stats: dict | None = None,
                        async_federation: dict | None = None,
-                       stream: dict | None = None) -> dict:
+                       stream: dict | None = None,
+                       costmodel: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -87,15 +101,19 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     simulator's per-round deadline/buffer outcome) upgrades it to v4
     under the ``"async"`` key; a stream dict (the streamer's
     per-dispatch transfer stats, parallel/streaming.py) upgrades it to
-    v5 under the ``"stream"`` key.
+    v5 under the ``"stream"`` key; a costmodel dict
+    (telemetry/costmodel.costmodel_record) upgrades it to v6 under the
+    ``"costmodel"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
-    ) and stream is None:
+    ) and stream is None and costmodel is None:
         return base
     record = dict(base)
-    if stream is not None:
+    if costmodel is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif stream is not None:
+        record["schema_version"] = _STREAM_SCHEMA_VERSION
     elif async_federation is not None:
         record["schema_version"] = _ASYNC_SCHEMA_VERSION
     elif client_stats is not None:
@@ -110,6 +128,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["async"] = async_federation
     if stream is not None:
         record["stream"] = stream
+    if costmodel is not None:
+        record["costmodel"] = costmodel
     return record
 
 

@@ -17,6 +17,7 @@ import glob
 import gzip
 import json
 import os
+import re
 
 import jax
 
@@ -89,6 +90,133 @@ def parse_device_trace(trace_dir: str) -> dict:
         "bytes_gb": bytes_total / 2**30,
         "op_count": op_count,
     }
+
+
+# Stage-attribution rules for the flagship ResNet-18 chunk-40 program
+# (promoted from scripts/trace_categories.py, which is now a thin CLI
+# wrapper): shape signatures in ``long_name`` -> pipeline stage. Ordered;
+# first match wins. These are program-specific by design — the generic
+# op-CLASS classification the cost model uses is :func:`classify_op`.
+STAGE_RULES = [
+    ("s4_wgrad", r"3,3,512,512.*fusion\(|fusion.*= f32\[3,3,512,512\]"),
+    ("s3_wgrad", r"= f32\[3,3,256,256\]"),
+    ("s2_wgrad", r"= f32\[3,3,128,128\]"),
+    ("s1_wgrad", r"= f32\[3,3,128,40,128\]|= f32\[3,4,3,40,128\]|= f32\[3,2,128,40,"),
+    ("stage4", r"4,4,512|2,2,512"),
+    ("stage3", r"8,8,256"),
+    ("stage2", r"16,16,128"),
+    # stage-1 folded activations: NHWC [.., 32, 16, 128] (rounds 3-4) or
+    # HWNC [32, 16, .., 128] (round 5); packed kernels/grads either way.
+    ("stage1f", r"32,16,128|32,16,40,25,128|32,16,1000,128"
+                r"|3,3,128,40,128|3,4,3,40,128"),
+    ("dense/head", r"512,10|,10\]"),
+    ("decode", r"u8\[|s32\["),
+]
+
+# Generic HLO op classes for the roofline cost model
+# (telemetry/costmodel.py): every traced device op lands in exactly one.
+OP_CLASSES = (
+    "matmul_conv",   # MXU work: dots, convolutions, their fusions
+    "elementwise",   # VPU work: loop/input fusions, reduces, converts
+    "copy_layout",   # pure data movement: copies, transposes, bitcasts
+    "collective",    # cross-chip: all-reduce/-gather/-to-all, permutes
+    "decode",        # uint8 shard decode (compact_client_data path)
+    "other",
+)
+
+_COLLECTIVE_MARKS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+_COPY_PREFIXES = ("copy", "transpose", "bitcast")
+# "convolution", not "conv": XLA's elementwise converts
+# ("convert_reduce_fusion") must not read as MXU work.
+_MATMUL_MARKS = ("convolution", "dot", "einsum", "gemm", "matmul")
+
+
+def classify_op(name: str, long_name: str = "") -> str:
+    """Map one device op to its :data:`OP_CLASSES` bucket.
+
+    Classification reads the op NAME first (XLA names fusions after their
+    root/hero op: ``convolution_convert_fusion``, ``loop_reduce_fusion``,
+    ``all-reduce.1``) and falls back to ``long_name`` markers. Order
+    matters and is part of the contract (tests/test_tracing.py):
+    collectives before matmul (an all-reduce OF conv grads is collective
+    volume, not MXU work), decode before elementwise (the u8 shard
+    decode is its own byte budget), copies only by name PREFIX (a
+    ``fusion`` whose long_name merely mentions copy is not a copy).
+    """
+    lowered = name.lower()
+    if any(m in lowered for m in _COLLECTIVE_MARKS):
+        return "collective"
+    if lowered.startswith(_COPY_PREFIXES):
+        return "copy_layout"
+    if "u8[" in long_name:
+        # The compact_client_data shard decode specifically — s32 is NOT
+        # a decode mark here: eval argmax outputs and cohort-index
+        # streams carry s32 and must keep their own class (STAGE_RULES
+        # keeps the wider u8|s32 rule for the flagship stage map).
+        return "decode"
+    if any(m in lowered for m in _MATMUL_MARKS) or (
+        "dot_general" in long_name or "convolution" in long_name
+    ):
+        return "matmul_conv"
+    if lowered.startswith(("fusion", "loop_", "input_", "reduce", "convert",
+                           "broadcast", "select", "add", "multiply",
+                           "subtract", "compare", "iota", "rng")):
+        return "elementwise"
+    return "other"
+
+
+def categorize_long_name(long_name: str, rules=STAGE_RULES) -> str:
+    """First-match rule category of one op's ``long_name`` (the stage
+    attribution scripts/trace_categories.py prints); "other" when no
+    rule matches."""
+    for cat, pat in rules:
+        if re.search(pat, long_name):
+            return cat
+    return "other"
+
+
+def categorize_ops(trace_dir: str, rules=None) -> dict[str, dict]:
+    """Categorized op LEDGER of a trace directory — the cost model's
+    input (telemetry/costmodel.py) and the shared core of
+    scripts/trace_categories.py.
+
+    One pass over :func:`iter_device_ops` (the SAME selection rule as the
+    bench proxy — wrapper ``while``/``jit(`` frames excluded, so ledger
+    totals reconcile with :func:`parse_device_trace`), aggregating per
+    category: ``{"device_ms", "bytes_gb", "flops_g", "op_count"}``.
+    ``flops_g`` sums the per-op ``flops`` annotation where the trace
+    carries one (TPU op profiles; absent on CPU traces and on most
+    tunneled-chip traces, in which case the ledger is byte/time-only and
+    the roofline model runs memory-side only — the measured programs ARE
+    memory-bound, docs/PERFORMANCE.md).
+
+    ``rules=None`` classifies into the generic :data:`OP_CLASSES` via
+    :func:`classify_op`; passing an ordered ``[(category, regex), ...]``
+    list (e.g. :data:`STAGE_RULES`) attributes by ``long_name`` instead.
+    Missing/empty trace dirs return an empty ledger, never raise.
+    """
+    ledger: dict[str, dict] = {}
+    for ev in iter_device_ops(trace_dir):
+        args = ev.get("args") or {}
+        long_name = args.get("long_name", "")
+        if rules is not None:
+            cat = categorize_long_name(long_name, rules)
+        else:
+            cat = classify_op(ev.get("name", ""), long_name)
+        entry = ledger.setdefault(cat, {
+            "device_ms": 0.0, "bytes_gb": 0.0, "flops_g": 0.0,
+            "op_count": 0,
+        })
+        entry["device_ms"] += float(ev.get("dur", 0.0)) / 1e3
+        entry["bytes_gb"] += float(
+            args.get("raw_bytes_accessed", 0) or 0
+        ) / 2**30
+        entry["flops_g"] += float(args.get("flops", 0) or 0) / 1e9
+        entry["op_count"] += 1
+    return ledger
 
 
 def top_device_ops(trace_dir: str, k: int = 10,

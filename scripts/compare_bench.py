@@ -40,7 +40,15 @@ rounds over the sync counterfactual): ``--async-speedup-threshold``
 is an absolute floor, default 1.0. And the ``stream`` leg's prefetch
 ``overlap_ratio`` (fraction of host->HBM upload time hidden behind
 compute at the largest swept population, client_residency='streamed'):
-``--stream-overlap-threshold`` is an absolute floor, default 0.5.
+``--stream-overlap-threshold`` is an absolute floor, default 0.5. The
+``costmodel`` leg's ``model_error_ratio`` per program (predicted /
+measured per-round ms from the roofline model, telemetry/costmodel.py)
+is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
+default 0.35 — wide enough for the documented ~25% device-vs-wall
+host-side share on the cnn headline, docs/PERFORMANCE.md § Predicted
+pod-scale cost): a prediction drifting out of band means the program
+changed character faster than the fitted model — refit deliberately
+(docs update) instead of letting capacity plans rot silently.
 
 Deliberately imports nothing heavy (no jax): usable as a CI gate and
 fast enough to self-test in tier-1 (tests/test_compare_bench.py).
@@ -70,7 +78,9 @@ TRACKED = [
     # the difference of two noisy medians hovering near zero, so a
     # relative-change gate on it would flap (0.01 -> 0.02 reads as
     # +100%). The absolute in-record gate (overhead_gate) is the designed
-    # mechanism.
+    # mechanism. costmodel.*.model_error_ratio follows the same rule
+    # (near-1.0 ratios must never be tracked relatively — PR 4/5
+    # precedent): the absolute band gate (model_drift_gate) judges it.
 ]
 
 
@@ -229,6 +239,38 @@ def stream_overlap_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def model_drift_gate(record: dict, threshold: float) -> list[dict]:
+    """In-record cost-model drift gate: bench.py's ``costmodel`` leg
+    records, per proxied program, the roofline model's predicted-vs-
+    measured per-round ratio (``model_error_ratio``,
+    telemetry/costmodel.py). A ratio outside the absolute band
+    ``1.0 +- threshold`` means the analytic model no longer describes
+    the program it prices — capacity projections built on it are stale
+    and the efficiency factors need a deliberate, documented refit
+    (docs/PERFORMANCE.md § Predicted pod-scale cost). Judged as an
+    absolute BAND, never relatively (the ratio sits near a fixed
+    operating point, where a relative gate would flap); returns one
+    regression entry per out-of-band program, empty when the leg is
+    absent or every ratio holds."""
+    out = []
+    for program in ("cnn", "flagship"):
+        ratio = get_path(record, f"costmodel.{program}.model_error_ratio")
+        if ratio is None or abs(ratio - 1.0) <= threshold:
+            continue
+        out.append({
+            "metric": f"costmodel.{program}.model_error_ratio",
+            "description": (
+                f"roofline-predicted vs measured per-round time of the "
+                f"{program} program (must stay within 1.0 +- "
+                f"{threshold:g}; refit the model deliberately, see "
+                "docs/PERFORMANCE.md)"
+            ),
+            "old": threshold, "new": ratio,
+            "relative_change": None, "direction": "near-1.0",
+        })
+    return out
+
+
 def _fmt(entry: dict) -> str:
     rel = entry["relative_change"]
     rel_s = f"{rel:+.1%}" if rel is not None else "n/a"
@@ -269,6 +311,12 @@ def main(argv: list[str] | None = None) -> int:
                          "record's stream leg at its largest population "
                          "(default 0.5 — at least half the host->HBM "
                          "upload time must hide behind compute)")
+    ap.add_argument("--model-drift-threshold", type=float, default=0.35,
+                    help="max tolerated |model_error_ratio - 1| in the NEW "
+                         "record's costmodel leg, per program (default "
+                         "0.35: the band covers the documented ~25% "
+                         "device-vs-wall host-side share on the cnn "
+                         "headline plus fit residuals)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable comparison as JSON")
     args = ap.parse_args(argv)
@@ -298,6 +346,9 @@ def main(argv: list[str] | None = None) -> int:
     ):
         if gate is not None:
             result["regressions"].append(gate)
+    result["regressions"].extend(
+        model_drift_gate(new, args.model_drift_threshold)
+    )
     if args.json:
         print(json.dumps(result, indent=2))
     else:

@@ -25,8 +25,15 @@ buffer-occupancy timeline, staleness histogram, simulated-clock speedup
 vs sync; see docs/OBSERVABILITY.md), v5 (``stream`` sub-object —
 rendered as an h2d transfer row under the phase table plus run-total
 transfer accounting; client_residency='streamed',
-docs/PERFORMANCE.md § Streamed client state). The only heavy import (jax, via utils.tracing)
-is deferred behind ``--trace``, so metrics-only reporting is instant.
+docs/PERFORMANCE.md § Streamed client state), v6 (``costmodel``
+sub-object — rendered as the "cost at scale" section: the roofline
+model's predicted round time, bottleneck, and $/run across the
+topology table, with this run's measured round as the anchor row;
+telemetry/costmodel.py). ``--trace`` computes the same section LIVE
+from the trace's categorized ledger when the records don't carry one
+(``--cost-rounds`` sets the $/run horizon). The only heavy import
+(jax, via utils.tracing) is deferred behind ``--trace``, so
+metrics-only reporting is instant.
 """
 
 from __future__ import annotations
@@ -191,7 +198,8 @@ def summarize_async(records: list[dict]) -> dict | None:
 
 def summarize_run(records: list[dict], trace_stats: dict | None = None,
                   top_ops: list[dict] | None = None,
-                  top_ops_time: list[dict] | None = None) -> dict:
+                  top_ops_time: list[dict] | None = None,
+                  costmodel: dict | None = None) -> dict:
     """Aggregate metrics records into the machine-readable summary the
     terminal renderer and ``--json`` output share."""
     if not records:
@@ -318,6 +326,17 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     async_summary = summarize_async(records)
     if async_summary is not None:
         summary["async_federation"] = async_summary
+
+    # --- costmodel sub-object (schema v6, cost_model_trace) -----------------
+    # Explicit costmodel (computed live from --trace) wins; otherwise the
+    # LAST record carrying one (the simulator attaches it to the run's
+    # final record).
+    if costmodel is None:
+        cms = [r["costmodel"] for r in records
+               if isinstance(r.get("costmodel"), dict)]
+        costmodel = cms[-1] if cms else None
+    if costmodel is not None:
+        summary["costmodel"] = costmodel
 
     if trace_stats is not None:
         summary["trace"] = trace_stats
@@ -487,6 +506,50 @@ def render_summary(summary: dict) -> list[str]:
                 f"vs {a['sim_clock_sync_s']:.1f}s sync — "
                 f"{a['speedup_vs_sync']:.2f}x speedup"
             )
+    if "costmodel" in summary:
+        # "What would this cost at scale": the roofline prediction per
+        # topology-table entry, measured run as the anchor row.
+        cm = summary["costmodel"]
+        run_rounds = cm.get("run_rounds")
+        horizon = f" @ {run_rounds} rounds" if run_rounds else ""
+        lines.append(
+            f"cost at scale (roofline on the traced ledger; "
+            f"anchor {cm['anchor_topology']}{horizon}):"
+        )
+        if cm.get("measured_ms") is not None:
+            lines.append(
+                f"  measured   {cm['anchor_topology']:<10} "
+                f"round {cm['measured_ms']:>10.1f} ms  (this run — "
+                f"anchor)"
+            )
+        for name, t in (cm.get("per_topology") or {}).items():
+            usd_run = t.get("usd_per_run")
+            cost = (
+                f"  ${usd_run:.2f}/run" if usd_run is not None else
+                f"  ${t.get('usd_per_round', 0):.6f}/round"
+            )
+            lines.append(
+                f"  predicted  {name:<10} "
+                f"round {t['predicted_ms']:>10.1f} ms  x{t['chips']:<4}"
+                f"{t.get('bottleneck', '?')}-bound{cost}"
+            )
+        if cm.get("model_error_ratio") is not None:
+            lines.append(
+                f"  model error: predicted/measured = "
+                f"{cm['model_error_ratio']:.3f} "
+                f"(band gated by compare_bench --model-drift-threshold)"
+            )
+        cats = cm.get("categories") or {}
+        if cats:
+            lines.append("  per-category roofline (per round, anchor):")
+            for cat, c in sorted(
+                cats.items(), key=lambda kv: -kv[1]["predicted_ms"]
+            ):
+                lines.append(
+                    f"    {cat:<12} {c['predicted_ms']:>9.2f} ms "
+                    f"predicted  {c['bytes_gb']:>8.2f} GB  "
+                    f"{c.get('bottleneck', '?')}-bound"
+                )
     if "trace" in summary:
         t = summary["trace"]
         lines.append(
@@ -522,15 +585,31 @@ def main(argv: list[str] | None = None) -> int:
                     help="also write the summary as JSON to this path")
     ap.add_argument("--top", type=int, default=10,
                     help="top-K device ops from --trace (default 10)")
+    ap.add_argument("--trace-rounds", type=int, default=1,
+                    help="rounds the --trace covers (per-round basis of "
+                         "the cost model; default 1)")
+    ap.add_argument("--cost-topology", default=None,
+                    help="topology-table anchor for the --trace cost "
+                         "model (default: costmodel.DEFAULT_ANCHOR)")
+    ap.add_argument("--cost-rounds", type=int, default=None,
+                    help="run horizon for the $/run projection (default: "
+                         "this run's recorded round count)")
     args = ap.parse_args(argv)
 
     try:
         records = load_metrics(args.artifacts)
-        trace_stats = top_ops = top_ops_time = None
+        trace_stats = top_ops = top_ops_time = costmodel = None
         if args.trace:
             # Deferred: utils.tracing imports jax. One gzip pass serves
-            # the totals and both rankings.
+            # the totals and both rankings; a second builds the cost
+            # model's categorized ledger.
+            from distributed_learning_simulator_tpu.telemetry.costmodel import (  # noqa: E501
+                DEFAULT_ANCHOR,
+                costmodel_record,
+                ledger_totals,
+            )
             from distributed_learning_simulator_tpu.utils.tracing import (
+                categorize_ops,
                 device_op_report,
             )
 
@@ -538,8 +617,25 @@ def main(argv: list[str] | None = None) -> int:
             trace_stats = report["totals"]
             top_ops = report["by_bytes"]
             top_ops_time = report["by_time"]
+            ledger = categorize_ops(args.trace)
+            if ledger and ledger_totals(ledger)["bytes_gb"] > 0:
+                # Anchor on this run's measured steady rounds (round 0
+                # carries compile when more than one record exists).
+                secs = [r["round_seconds"] for r in records
+                        if "round_seconds" in r]
+                steady = secs[1:] or secs
+                costmodel = costmodel_record(
+                    ledger,
+                    trace_rounds=args.trace_rounds,
+                    anchor=args.cost_topology or DEFAULT_ANCHOR,
+                    measured_ms=(
+                        1e3 * statistics.median(steady) if steady else None
+                    ),
+                    run_rounds=args.cost_rounds or len(records),
+                )
         summary = summarize_run(records, trace_stats=trace_stats,
-                                top_ops=top_ops, top_ops_time=top_ops_time)
+                                top_ops=top_ops, top_ops_time=top_ops_time,
+                                costmodel=costmodel)
     except (FileNotFoundError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -1,70 +1,68 @@
 """Aggregate a saved device trace by program category (stage attribution).
 
-Usage: python scripts/trace_categories.py <trace_dir> [top_n]
+Usage: python scripts/trace_categories.py <trace_dir> [top_n] [category...]
 
 Buckets ops by shape signatures in ``long_name`` (ResNet-18 stage maps at
 the flagship chunk-40 config), so a round's device time reads as a stage
 budget instead of 3000 instance rows. Pure-CPU parse of an existing trace.
+
+Thin CLI wrapper since ISSUE 8: the rule table and the categorizer are
+the tested public API in ``utils/tracing`` (``STAGE_RULES``,
+``categorize_long_name``, ``categorize_ops``) — the cost model
+(telemetry/costmodel.py) consumes the same ledger machinery with its
+generic op-class rules, so the selection rule (wrapper ``while``/``jit(``
+frames excluded) lives in exactly one place.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 from collections import defaultdict
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from distributed_learning_simulator_tpu.utils.tracing import iter_device_ops
+from distributed_learning_simulator_tpu.utils.tracing import (
+    STAGE_RULES,
+    categorize_long_name,
+    categorize_ops,
+    iter_device_ops,
+)
 
-RULES = [
-    ("s4_wgrad", r"3,3,512,512.*fusion\(|fusion.*= f32\[3,3,512,512\]"),
-    ("s3_wgrad", r"= f32\[3,3,256,256\]"),
-    ("s2_wgrad", r"= f32\[3,3,128,128\]"),
-    ("s1_wgrad", r"= f32\[3,3,128,40,128\]|= f32\[3,4,3,40,128\]|= f32\[3,2,128,40,"),
-    ("stage4", r"4,4,512|2,2,512"),
-    ("stage3", r"8,8,256"),
-    ("stage2", r"16,16,128"),
-    # stage-1 folded activations: NHWC [.., 32, 16, 128] (rounds 3-4) or
-    # HWNC [32, 16, .., 128] (round 5); packed kernels/grads either way.
-    ("stage1f", r"32,16,128|32,16,40,25,128|32,16,1000,128"
-                r"|3,3,128,40,128|3,4,3,40,128"),
-    ("dense/head", r"512,10|,10\]"),
-    ("decode", r"u8\[|s32\["),
-]
-
-
-def categorize(long_name: str) -> str:
-    for name, pat in RULES:
-        if re.search(pat, long_name):
-            return name
-    return "other"
+# Backwards-compatible aliases (pre-ISSUE-8 importers of this script).
+RULES = STAGE_RULES
+categorize = categorize_long_name
 
 
 def main():
     trace_dir = sys.argv[1]
     top = int(sys.argv[2]) if len(sys.argv) > 2 else 15
-    cats = defaultdict(lambda: [0.0, 0.0, 0])
+    cats = categorize_ops(trace_dir, rules=STAGE_RULES)
+    total = sum(e["device_ms"] for e in cats.values())
+    print(f"total device op time: {total:.1f} ms")
+    print(f"{'category':12s} {'ms':>9s} {'GB':>9s} {'GB/s':>7s} {'n':>6s}")
+    for cat, e in sorted(cats.items(), key=lambda kv: -kv[1]["device_ms"]):
+        gbps = (
+            e["bytes_gb"] / (e["device_ms"] / 1e3)
+            if e["device_ms"] else 0.0
+        )
+        print(f"{cat:12s} {e['device_ms']:9.1f} {e['bytes_gb']:9.2f} "
+              f"{gbps:7.0f} {e['op_count']:6d}")
+    wanted = sys.argv[3:]
+    if not wanted:
+        return
+    # Per-op detail rows only when asked: a second gzip pass, keyed the
+    # way the original script printed them.
     ops = defaultdict(lambda: [0.0, 0.0, 0])
-    total = 0.0
     for ev in iter_device_ops(trace_dir):
         args = ev.get("args") or {}
         ln = args.get("long_name", "")
-        dur = float(ev.get("dur", 0.0))
-        byt = float(args.get("raw_bytes_accessed", 0) or 0)
-        cat = categorize(ln)
-        for store in (cats[cat], ops[(cat, ev.get("name", "?").split(".")[0], ln[:100])]):
-            store[0] += dur
-            store[1] += byt
-            store[2] += 1
-        total += dur
-    print(f"total device op time: {total / 1e3:.1f} ms")
-    print(f"{'category':12s} {'ms':>9s} {'GB':>9s} {'GB/s':>7s} {'n':>6s}")
-    for cat, (dur, byt, cnt) in sorted(cats.items(), key=lambda kv: -kv[1][0]):
-        gbps = (byt / 2**30) / (dur / 1e6) if dur else 0.0
-        print(f"{cat:12s} {dur / 1e3:9.1f} {byt / 2**30:9.2f} {gbps:7.0f} {cnt:6d}")
-    for want in sys.argv[3:]:
+        cat = categorize_long_name(ln)
+        key = (cat, ev.get("name", "?").split(".")[0], ln[:100])
+        ops[key][0] += float(ev.get("dur", 0.0))
+        ops[key][1] += float(args.get("raw_bytes_accessed", 0) or 0)
+        ops[key][2] += 1
+    for want in wanted:
         print(f"\n--- top ops in {want} ---")
         rows = sorted(
             ((k, v) for k, v in ops.items() if k[0] == want),

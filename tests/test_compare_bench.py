@@ -256,6 +256,67 @@ def test_stream_overlap_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_model_drift_not_relatively_tracked(cb):
+    """model_error_ratio sits near 1.0 — like the other in-record
+    ratios it must never be a relative TRACKED metric (PR 4/5
+    precedent); only the absolute band gate judges it."""
+    old = _record(costmodel={"cnn": {"model_error_ratio": 1.02}})
+    new = _record(costmodel={"cnn": {"model_error_ratio": 0.93}})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "costmodel" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_model_drift_gate_is_a_band(cb):
+    """The in-record gate fires when predicted-vs-measured leaves the
+    absolute band around 1.0 — in EITHER direction, per program."""
+    assert cb.model_drift_gate(_record(), 0.35) == []  # leg absent
+    ok = _record(costmodel={
+        "cnn": {"model_error_ratio": 0.75},
+        "flagship": {"model_error_ratio": 1.0},
+        "pod_projection": {"topology": "v4-32"},
+    })
+    assert cb.model_drift_gate(ok, 0.35) == []
+    # Under-prediction out of band (cnn) and over-prediction out of
+    # band (flagship) both gate, each with its own entry.
+    bad = _record(costmodel={
+        "cnn": {"model_error_ratio": 0.5},
+        "flagship": {"model_error_ratio": 1.6},
+    })
+    entries = cb.model_drift_gate(bad, 0.35)
+    assert {e["metric"] for e in entries} == {
+        "costmodel.cnn.model_error_ratio",
+        "costmodel.flagship.model_error_ratio",
+    }
+    # A leg that degraded to an error sub-object is skipped, not gated.
+    degraded = _record(costmodel={"cnn": {"error": "no byte annotations"}})
+    assert cb.model_drift_gate(degraded, 0.35) == []
+
+
+def test_model_drift_gate_cli(cb, tmp_path):
+    """The drift gate alone must exit 1, and the threshold flag widens
+    the band back to passing."""
+    old_p, bad_p = tmp_path / "old.json", tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(
+        _record(costmodel={"flagship": {"model_error_ratio": 1.55}})
+    ))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "costmodel.flagship.model_error_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--model-drift-threshold", "0.6"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_provenance_refusal(cb):
     old, new = _record(), _record()
     new["config_hash"] = "fedcba654321"

@@ -1,0 +1,403 @@
+"""telemetry/costmodel.py + topologies.py: the predictive cost model.
+
+Hand-computed roofline numbers on a tiny synthetic ledger (efficiency
+factors pinned to 1.0 so the arithmetic is exact), topology-table
+validation, the schema-v6 ``costmodel`` record shape against the
+checked-in JSON schema, a gzipped-trace-fixture end-to-end pass (same
+fixture pattern as tests/test_tracing.py — the wrapper-frame exclusion
+rule is shared with the bench proxy), and the simulator integration:
+``cost_model_trace`` attaches the sub-object to the run's LAST record
+only, and the default keeps records at schema v5 or below.
+"""
+
+import dataclasses
+import gzip
+import json
+import os
+
+import jsonschema
+import pytest
+
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.telemetry.costmodel import (
+    DEFAULT_ANCHOR,
+    DEFAULT_EFFICIENCY,
+    GIB,
+    costmodel_record,
+    ledger_totals,
+    predict_round,
+)
+from distributed_learning_simulator_tpu.telemetry.topologies import (
+    TOPOLOGIES,
+    Topology,
+    get_topology,
+)
+from distributed_learning_simulator_tpu.utils.reporting import (
+    build_round_record,
+    config_hash,
+)
+
+_EXACT = {"mxu": 1.0, "hbm": 1.0, "ici": 1.0}
+
+# One GiB/s of SI bandwidth: makes bytes/seconds arithmetic exact below.
+_GIBPS = GIB / 1e9
+
+
+def _toy(chips=1, peak_tflops=1e-3, hbm_gbps=_GIBPS, ici_gbps=_GIBPS,
+         usd=3.6):
+    return Topology("toy", chips, peak_tflops, hbm_gbps, ici_gbps, usd)
+
+
+# ------------------------------------------------------------- topologies
+
+
+def test_topology_table_contents():
+    """The checked-in table must keep the entries the docs and the bench
+    anchor name — including a >= 32-chip pod (the acceptance
+    projection) — with physically sensible positive specs."""
+    for required in ("cpu-host", "v5e-1", "v5e-8", "v4-8", "v4-32"):
+        assert required in TOPOLOGIES, required
+    assert DEFAULT_ANCHOR in TOPOLOGIES
+    assert any(t.chips >= 32 for t in TOPOLOGIES.values())
+    for t in TOPOLOGIES.values():
+        assert t.chips >= 1
+        assert t.peak_tflops > 0 and t.hbm_gbps > 0
+        assert t.ici_gbps >= 0 and t.usd_per_chip_hour >= 0
+        assert TOPOLOGIES[t.name] is t  # keys match names
+
+
+def test_get_topology_error_names_known_entries():
+    assert get_topology("v4-32").chips == 32
+    with pytest.raises(ValueError, match="v5e-1"):
+        get_topology("v9-9000")
+
+
+def test_efficiency_factors_are_fractions_of_peak():
+    for name, value in DEFAULT_EFFICIENCY.items():
+        assert 0.0 < value <= 1.0, name
+
+
+# ------------------------------------------------------- roofline by hand
+
+
+def test_memory_bound_category_hand_computed():
+    """1 GiB over a 1 GiB/s topology at efficiency 1.0 = exactly 1 s."""
+    ledger = {"elementwise": {"bytes_gb": 1.0, "flops_g": 0.0,
+                              "device_ms": 5.0, "op_count": 3}}
+    pred = predict_round(ledger, _toy(), efficiency=_EXACT)
+    assert pred["predicted_ms"] == pytest.approx(1000.0)
+    assert pred["bottleneck"] == "memory"
+    assert pred["categories"]["elementwise"]["bottleneck"] == "memory"
+
+
+def test_compute_bound_category_hand_computed():
+    """2 GFLOP against a 1 GFLOP/s peak takes 2 s and beats its own
+    byte term — the category flips compute-bound."""
+    ledger = {"matmul_conv": {"bytes_gb": 1.0, "flops_g": 2.0,
+                              "device_ms": 5.0, "op_count": 1}}
+    pred = predict_round(ledger, _toy(), efficiency=_EXACT)
+    assert pred["predicted_ms"] == pytest.approx(2000.0)
+    assert pred["bottleneck"] == "compute"
+
+
+def test_chips_divide_bytes_and_trace_rounds_normalize():
+    """Data-parallel scaling: n chips divide the byte volume; a trace
+    covering 2 rounds halves the per-round basis."""
+    ledger = {"elementwise": {"bytes_gb": 1.0, "flops_g": 0.0,
+                              "device_ms": 5.0, "op_count": 3}}
+    two_chip = predict_round(ledger, _toy(chips=2), efficiency=_EXACT)
+    assert two_chip["predicted_ms"] == pytest.approx(500.0)
+    per_round = predict_round(ledger, _toy(), trace_rounds=2,
+                              efficiency=_EXACT)
+    assert per_round["predicted_ms"] == pytest.approx(500.0)
+    with pytest.raises(ValueError, match="trace_rounds"):
+        predict_round(ledger, _toy(), trace_rounds=0)
+
+
+def test_collective_category_rides_ici():
+    """Traced collective volume: each of 4 chips moves its 1/4 share
+    over 1 GiB/s of ICI = 0.25 s; on a single chip (no ICI) the same
+    bytes are charged to HBM instead."""
+    ledger = {"collective": {"bytes_gb": 1.0, "flops_g": 0.0,
+                             "device_ms": 5.0, "op_count": 2}}
+    pred = predict_round(ledger, _toy(chips=4), efficiency=_EXACT)
+    assert pred["predicted_ms"] == pytest.approx(250.0)
+    assert pred["bottleneck"] == "collective"
+    single = predict_round(ledger, _toy(chips=1), efficiency=_EXACT)
+    assert single["predicted_ms"] == pytest.approx(1000.0)
+    assert single["bottleneck"] == "memory"
+
+
+def test_allreduce_estimate_needs_params_and_chips():
+    """The FedAvg global-model all-reduce (absent from single-chip
+    traces) is estimated from param_bytes: 2 * P * (n-1)/n over ICI."""
+    ledger = {"elementwise": {"bytes_gb": 1.0, "flops_g": 0.0,
+                              "device_ms": 5.0, "op_count": 3}}
+    base = predict_round(ledger, _toy(chips=2), efficiency=_EXACT)
+    with_ar = predict_round(ledger, _toy(chips=2), efficiency=_EXACT,
+                            param_bytes=GIB)
+    # 2 * 1 GiB * 1/2 / 1 GiB/s = 1 s on top of the 0.5 s memory term.
+    assert with_ar["predicted_ms"] - base["predicted_ms"] == (
+        pytest.approx(1000.0)
+    )
+    assert with_ar["bottleneck"] == "collective"
+    # Single chip: no interconnect, no all-reduce charge.
+    alone = predict_round(ledger, _toy(chips=1), efficiency=_EXACT,
+                          param_bytes=GIB)
+    assert alone["predicted_ms"] == pytest.approx(1000.0)
+
+
+def test_ledger_totals():
+    ledger = {
+        "a": {"bytes_gb": 1.0, "flops_g": 2.0, "device_ms": 3.0,
+              "op_count": 4},
+        "b": {"bytes_gb": 0.5, "flops_g": 0.0, "device_ms": 1.0,
+              "op_count": 1},
+    }
+    t = ledger_totals(ledger)
+    assert t == {"bytes_gb": 1.5, "flops_g": 2.0, "device_ms": 4.0,
+                 "op_count": 5}
+    assert ledger_totals({})["bytes_gb"] == 0.0
+
+
+# ------------------------------------------------------- costmodel_record
+
+
+def _ledger():
+    return {"elementwise": {"bytes_gb": 1.0, "flops_g": 0.0,
+                            "device_ms": 5.0, "op_count": 3}}
+
+
+def test_costmodel_record_anchor_and_error_ratio():
+    topos = {"toy": _toy(), "toy-4": _toy(chips=4)}
+    rec = costmodel_record(
+        _ledger(), anchor="toy", measured_ms=500.0, topologies=topos,
+        efficiency=_EXACT, run_rounds=100,
+    )
+    assert rec["anchor_topology"] == "toy"
+    assert rec["predicted_ms"] == pytest.approx(1000.0)
+    assert rec["measured_ms"] == 500.0
+    # predicted / measured: the drift-gate number.
+    assert rec["model_error_ratio"] == pytest.approx(2.0)
+    assert rec["run_rounds"] == 100
+    assert set(rec["per_topology"]) == {"toy", "toy-4"}
+    assert rec["per_topology"]["toy-4"]["predicted_ms"] == (
+        pytest.approx(250.0)
+    )
+    # $/round at 3.6 USD/chip-hour: 1 s * 1 chip = 0.001 USD; $/run
+    # multiplies by the horizon.
+    assert rec["per_topology"]["toy"]["usd_per_round"] == (
+        pytest.approx(0.001)
+    )
+    assert rec["per_topology"]["toy"]["usd_per_run"] == pytest.approx(0.1)
+    # Per-category breakdown normalized to the per-round basis.
+    assert rec["categories"]["elementwise"]["bytes_gb"] == 1.0
+    assert rec["categories"]["elementwise"]["predicted_ms"] == (
+        pytest.approx(1000.0)
+    )
+
+
+def test_costmodel_record_without_measurement():
+    rec = costmodel_record(_ledger(), anchor="toy",
+                           topologies={"toy": _toy()}, efficiency=_EXACT)
+    assert rec["measured_ms"] is None
+    assert rec["model_error_ratio"] is None
+    assert "run_rounds" not in rec
+
+
+def test_costmodel_record_validates_against_metrics_schema():
+    """The record the builder emits IS the schema-v6 sub-object — pin it
+    against the same checked-in JSON schema the metrics tests use."""
+    with open(os.path.join(os.path.dirname(__file__), "data",
+                           "metrics_record.schema.json")) as f:
+        schema = json.load(f)
+    rec = costmodel_record(_ledger(), anchor="v5e-1", measured_ms=123.4,
+                           run_rounds=150)
+    record = build_round_record(
+        {"round": 1, "test_accuracy": 0.5, "test_loss": 1.0,
+         "round_seconds": 0.1}, None, None, None, None, rec,
+    )
+    assert record["schema_version"] == 6
+    jsonschema.validate(record, schema)
+
+
+# ------------------------------------------------- trace fixture -> model
+
+
+def _write_trace(root, events):
+    d = os.path.join(root, "plugins", "profile", "run1")
+    os.makedirs(d, exist_ok=True)
+    with gzip.open(os.path.join(d, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_trace_fixture_to_prediction_end_to_end(tmp_path):
+    """Gzipped fixture -> categorize_ops -> costmodel_record: classes
+    land where classify_op says, wrapper frames stay excluded (the rule
+    shared with the bench proxy), and the roofline sums per category."""
+    from distributed_learning_simulator_tpu.utils.tracing import (
+        categorize_ops,
+    )
+
+    def op(name, dur_us, nbytes, long_name="", flops=None):
+        args = {"raw_bytes_accessed": nbytes, "long_name": long_name}
+        if flops is not None:
+            args["flops"] = flops
+        return {"ph": "X", "name": name, "dur": dur_us, "args": args}
+
+    _write_trace(str(tmp_path), [
+        op("convolution.1", 100.0, GIB, "convolution", flops=2e9),
+        op("fusion.2", 50.0, GIB // 2, "loop fusion root"),
+        op("copy.3", 10.0, GIB // 4),
+        op("all-reduce.4", 10.0, GIB // 4),
+        # Wrapper frames must not reach the ledger (double counting).
+        op("while", 1000.0, 100 * GIB),
+        op("jit(round_fn)", 1000.0, 100 * GIB, "jit frame"),
+    ])
+    ledger = categorize_ops(str(tmp_path))
+    assert set(ledger) == {"matmul_conv", "elementwise", "copy_layout",
+                           "collective"}
+    assert ledger["matmul_conv"]["bytes_gb"] == 1.0
+    assert ledger["matmul_conv"]["flops_g"] == pytest.approx(2.0)
+    assert ledger["elementwise"]["bytes_gb"] == 0.5
+    assert ledger_totals(ledger)["bytes_gb"] == 2.0
+
+    rec = costmodel_record(ledger, anchor="toy",
+                           topologies={"toy": _toy(chips=1)},
+                           efficiency=_EXACT)
+    # All four categories are memory-bound at these sizes (2 GFLOP vs
+    # 1 GFLOP/s loses to nothing here: 1 GiB / 1 GiB/s = 1 s < 2 s —
+    # compute wins for matmul_conv), so: matmul 2 s + 0.5 + 0.25 + 0.25.
+    assert rec["predicted_ms"] == pytest.approx(3000.0)
+    assert rec["categories"]["matmul_conv"]["bottleneck"] == "compute"
+    assert rec["bottleneck"] == "compute"
+
+
+# ------------------------------------------------- simulator integration
+
+
+def test_simulator_attaches_v6_record_on_last_round(tmp_path, tiny_config,
+                                                    tiny_dataset):
+    """cost_model_trace: the LAST record carries the schema-v6 costmodel
+    sub-object (validating against the checked-in schema), earlier
+    records keep their pre-v6 layout, and the result dict mirrors it."""
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    _write_trace(str(tmp_path), [{
+        "ph": "X", "name": "fusion.1", "dur": 100.0,
+        "args": {"raw_bytes_accessed": GIB, "long_name": "loop fusion"},
+    }])
+    config = dataclasses.replace(
+        tiny_config, cost_model_trace=str(tmp_path),
+        cost_model_trace_rounds=1, cost_model_topology="v5e-1",
+    )
+    result = run_simulation(config, dataset=tiny_dataset,
+                            setup_logging=False)
+    history = result["history"]
+    assert len(history) == config.round
+    assert "costmodel" not in history[0]
+    last = history[-1]
+    assert last["schema_version"] == 6
+    cm = last["costmodel"]
+    assert cm == result["costmodel"]
+    assert cm["anchor_topology"] == "v5e-1"
+    assert cm["predicted_ms"] > 0
+    assert cm["measured_ms"] > 0
+    assert cm["model_error_ratio"] is not None
+    assert cm["run_rounds"] == config.round
+    assert "v4-32" in cm["per_topology"]
+    with open(os.path.join(os.path.dirname(__file__), "data",
+                           "metrics_record.schema.json")) as f:
+        jsonschema.validate(last, json.load(f))
+
+
+def test_simulator_default_stays_pre_v6(tiny_config, tiny_dataset):
+    """cost_model_trace=None (default): no record carries a costmodel
+    sub-object and schema versions stay at v5 or below."""
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    result = run_simulation(tiny_config, dataset=tiny_dataset,
+                            setup_logging=False)
+    assert result["costmodel"] is None
+    for record in result["history"]:
+        assert "costmodel" not in record
+        assert record.get("schema_version", 1) <= 5
+
+
+def test_simulator_empty_trace_degrades(tmp_path, tiny_config,
+                                        tiny_dataset):
+    """A missing/empty trace dir disables the model with a warning
+    instead of emitting a fabricated zero-cost record."""
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    config = dataclasses.replace(
+        tiny_config, cost_model_trace=str(tmp_path / "nope"),
+    )
+    result = run_simulation(config, dataset=tiny_dataset,
+                            setup_logging=False)
+    assert result["costmodel"] is None
+    assert "costmodel" not in result["history"][-1]
+
+
+# ------------------------------------------------- report_run rendering
+
+
+def test_report_run_renders_cost_at_scale_section():
+    """The offline reporter's "cost at scale" section (jax-free): the
+    measured anchor row leads, every topology-table entry gets a
+    predicted row with chip count + bottleneck + $/run, and the
+    model-error ratio line names the compare_bench gate."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report_run",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "report_run.py"),
+    )
+    report_run = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report_run)
+
+    cm = costmodel_record(_ledger(), anchor="v5e-1", measured_ms=123.4,
+                          run_rounds=150)
+    records = [
+        {"round": 0, "round_seconds": 0.13, "accuracy": 0.4,
+         "schema_version": 1},
+        {"round": 1, "round_seconds": 0.12, "accuracy": 0.5,
+         "schema_version": 6, "costmodel": cm},
+    ]
+    summary = report_run.summarize_run(records)
+    # The LAST record carrying a costmodel wins (the simulator attaches
+    # it to the run's final record).
+    assert summary["costmodel"] == cm
+    text = "\n".join(report_run.render_summary(summary))
+    assert "cost at scale" in text
+    assert "measured   v5e-1" in text
+    for name, topo in TOPOLOGIES.items():
+        assert f"predicted  {name}" in text
+        assert f"x{topo.chips}" in text
+    assert "/run" in text
+    assert "model error: predicted/measured" in text
+    assert "--model-drift-threshold" in text
+
+
+# ----------------------------------------------------------- config knobs
+
+
+def test_cost_model_knobs_do_not_move_config_hash(tiny_config):
+    """Pure host-side analysis must not make runs incomparable."""
+    priced = dataclasses.replace(
+        tiny_config, cost_model_trace="/tmp/trace",
+        cost_model_trace_rounds=3, cost_model_topology="v4-8",
+    )
+    assert config_hash(priced) == config_hash(tiny_config)
+
+
+def test_config_validates_cost_model_knobs(tiny_config):
+    with pytest.raises(ValueError, match="topology"):
+        dataclasses.replace(
+            tiny_config, cost_model_topology="v99-bogus"
+        ).validate()
+    with pytest.raises(ValueError, match="cost_model_trace_rounds"):
+        dataclasses.replace(
+            tiny_config, cost_model_trace_rounds=0
+        ).validate()

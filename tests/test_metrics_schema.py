@@ -4,10 +4,11 @@ Jax-free (imports only utils.reporting + jsonschema): the schema at
 tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
-(+client_stats), v4 (+async) and v5 (+stream) records must validate;
-records that mix versions and sub-objects inconsistently must not. The
-integration tests in test_client_stats.py validate REAL produced
-records against the same file.
+(+client_stats), v4 (+async), v5 (+stream) and v6 (+costmodel) records
+must validate; records that mix versions and sub-objects inconsistently
+must not. The integration tests in test_client_stats.py (and
+test_costmodel.py for v6) validate REAL produced records against the
+same file.
 """
 
 import json
@@ -150,7 +151,7 @@ def test_v5_record_validates():
     record = build_round_record(
         _base(), _telemetry(), _client_stats(), _async(), _stream()
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 5
+    assert record["schema_version"] == 5
     validate(record)
     # stream alone (every other feature off) is still v5.
     validate(build_round_record(_base(), None, None, None, _stream()))
@@ -161,6 +162,69 @@ def test_v5_record_validates():
         "hidden_seconds": 0.0, "overlap_ratio": 0.0,
         "dispatch_rounds": 4,
     }))
+
+
+def _costmodel() -> dict:
+    return {
+        "anchor_topology": "v5e-1",
+        "predicted_ms": 2274.2,
+        "measured_ms": 2275.4,
+        "model_error_ratio": 0.9995,
+        "bottleneck": "memory",
+        "trace_rounds": 1,
+        "run_rounds": 150,
+        "categories": {
+            "matmul_conv": {
+                "bytes_gb": 348.967, "device_ms": 675.3, "flops_g": 0.0,
+                "predicted_ms": 635.5, "bottleneck": "memory",
+            },
+            "elementwise": {
+                "bytes_gb": 900.0, "device_ms": 1600.0, "flops_g": 0.0,
+                "predicted_ms": 1638.7, "bottleneck": "memory",
+            },
+        },
+        "per_topology": {
+            "v5e-1": {"chips": 1, "predicted_ms": 2274.2,
+                      "bottleneck": "memory", "usd_per_round": 0.000758,
+                      "usd_per_run": 0.1137},
+            "v4-32": {"chips": 32, "predicted_ms": 47.4,
+                      "bottleneck": "memory", "usd_per_round": 0.001357,
+                      "usd_per_run": 0.2035},
+        },
+    }
+
+
+def test_v6_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async(), _stream(),
+        _costmodel(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 6
+    validate(record)
+    # costmodel alone (every other feature off) is still v6 — the
+    # simulator's last-round record under cost_model_trace with
+    # telemetry_level='off'.
+    validate(build_round_record(
+        _base(), None, None, None, None, _costmodel()
+    ))
+    # Prediction without a measured anchor (offline pricing of a trace).
+    validate(build_round_record(_base(), None, None, None, None, {
+        **_costmodel(), "measured_ms": None, "model_error_ratio": None,
+    }))
+
+
+def test_lowest_version_stamping_preserved():
+    """Adding v6 must not disturb the lower stamps: the version is the
+    LOWEST that describes the record (longitudinal byte-identity)."""
+    assert "schema_version" not in build_round_record(_base())
+    assert build_round_record(_base(), _telemetry())[
+        "schema_version"] == 2
+    assert build_round_record(_base(), None, _client_stats())[
+        "schema_version"] == 3
+    assert build_round_record(_base(), None, None, _async())[
+        "schema_version"] == 4
+    assert build_round_record(_base(), None, None, None, _stream())[
+        "schema_version"] == 5
 
 
 def test_version_content_mismatches_rejected():
@@ -209,6 +273,40 @@ def test_version_content_mismatches_rejected():
         validate(bad)
     bad = build_round_record(
         _base(), None, None, None, {**_stream(), "mystery": 1}
+    )
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v5 stamp smuggling a costmodel sub-object (the builder always
+    # stamps costmodel records v6).
+    bad = build_round_record(_base(), None, None, None, _stream())
+    bad["costmodel"] = _costmodel()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v6 stamp without the costmodel sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 6
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown keys inside costmodel (top level, a category, a topology
+    # row) are schema breaks, not silent extensions.
+    for poison in (
+        {"mystery": 1},
+        {"categories": {"matmul_conv": {
+            "bytes_gb": 1.0, "predicted_ms": 1.0, "bottleneck": "memory",
+            "mystery": 1,
+        }}},
+        {"per_topology": {"v4-32": {"chips": 32, "predicted_ms": 1.0,
+                                    "mystery": 1}}},
+    ):
+        bad = build_round_record(
+            _base(), None, None, None, None, {**_costmodel(), **poison}
+        )
+        with pytest.raises(jsonschema.ValidationError):
+            validate(bad)
+    # A bottleneck outside the compute/memory/collective enum.
+    bad = build_round_record(
+        _base(), None, None, None, None,
+        {**_costmodel(), "bottleneck": "vibes"},
     )
     with pytest.raises(jsonschema.ValidationError):
         validate(bad)

@@ -15,6 +15,11 @@ import os
 import pytest
 
 from distributed_learning_simulator_tpu.utils.tracing import (
+    OP_CLASSES,
+    STAGE_RULES,
+    categorize_long_name,
+    categorize_ops,
+    classify_op,
     device_op_report,
     iter_device_ops,
     parse_device_trace,
@@ -110,6 +115,87 @@ def test_multiple_trace_files_are_summed(tmp_path):
     stats = parse_device_trace(str(tmp_path))
     assert stats["op_count"] == 2
     assert stats["bytes_gb"] == 2.0
+
+
+def test_classify_op_classes():
+    """The op-class rules the cost model prices by: collectives before
+    matmul (an all-reduce OF conv grads is ICI volume), copies by name
+    PREFIX only, the u8 shard decode as its own byte budget."""
+    assert classify_op("all-reduce.1") == "collective"
+    assert classify_op("reduce-scatter.2") == "collective"
+    assert classify_op("convolution.5", "convolution") == "matmul_conv"
+    assert classify_op("convolution_convert_fusion.3") == "matmul_conv"
+    assert classify_op("dot.3", "dot_general") == "matmul_conv"
+    assert classify_op("fusion.8", "... dot_general ...") == "matmul_conv"
+    assert classify_op("copy.2") == "copy_layout"
+    assert classify_op("transpose.1") == "copy_layout"
+    assert classify_op("bitcast.9") == "copy_layout"
+    # A fusion whose long_name merely mentions copy is NOT a copy.
+    assert classify_op("fusion.4", "copies nothing") == "elementwise"
+    assert classify_op("fusion.9", "u8[1000,50,3072]") == "decode"
+    # s32 alone is NOT decode: eval argmax / cohort-index fusions keep
+    # their own class (only the stage map treats s32 as decode).
+    assert classify_op("fusion.10", "s32[1000] argmax") == "elementwise"
+    assert classify_op("dot.4", "dot_general s32[40] indices") == \
+        "matmul_conv"
+    assert classify_op("loop_reduce_fusion.2") == "elementwise"
+    assert classify_op("convert.1") == "elementwise"
+    assert classify_op("dynamic-update-slice.1") == "other"
+    for name in ("all-reduce.1", "fusion.1", "copy.1", "custom-call.2"):
+        assert classify_op(name) in OP_CLASSES
+
+
+def test_categorize_long_name_stage_rules():
+    """The promoted scripts/trace_categories.py rule table: first match
+    wins, unmatched long_names land in 'other'."""
+    assert categorize_long_name("= f32[3,3,256,256]") == "s3_wgrad"
+    assert categorize_long_name("fusion over 8,8,256 tensors") == "stage3"
+    assert categorize_long_name("u8[1000,50,3072] decode") == "decode"
+    assert categorize_long_name("nothing recognizable") == "other"
+    assert [c for c, _ in STAGE_RULES][:4] == [
+        "s4_wgrad", "s3_wgrad", "s2_wgrad", "s1_wgrad",
+    ]
+
+
+def test_categorize_ops_ledger(tmp_path):
+    """categorize_ops shares iter_device_ops' selection rule (wrapper
+    frames excluded) and aggregates bytes/time/flops/count per class;
+    ledger totals reconcile with parse_device_trace."""
+    events = [
+        _op("convolution.1", 100.0, nbytes=GIB, long_name="convolution"),
+        _op("fusion.2", 50.0, nbytes=GIB // 2, long_name="loop fusion"),
+        _op("fusion.2", 25.0, nbytes=GIB // 2, long_name="loop fusion"),
+        _op("copy.3", 10.0, nbytes=GIB // 4),
+        _op("all-reduce.4", 5.0, nbytes=GIB // 4),
+        # Wrapper frames and unannotated host events stay excluded.
+        _op("while", 1000.0, nbytes=100 * GIB),
+        {"ph": "X", "name": "host_callback", "dur": 5.0},
+    ]
+    # One event carrying an XLA flops annotation.
+    events[0]["args"]["flops"] = 4e9
+    _write_trace(str(tmp_path), events)
+    ledger = categorize_ops(str(tmp_path))
+    assert set(ledger) == {"matmul_conv", "elementwise", "copy_layout",
+                           "collective"}
+    assert ledger["matmul_conv"] == {
+        "device_ms": pytest.approx(0.1), "bytes_gb": 1.0,
+        "flops_g": pytest.approx(4.0), "op_count": 1,
+    }
+    assert ledger["elementwise"]["op_count"] == 2
+    assert ledger["elementwise"]["bytes_gb"] == 1.0
+    totals = parse_device_trace(str(tmp_path))
+    assert sum(e["bytes_gb"] for e in ledger.values()) == pytest.approx(
+        totals["bytes_gb"]
+    )
+    assert sum(e["op_count"] for e in ledger.values()) == (
+        totals["op_count"]
+    )
+    # Stage-rule mode: the same pass keyed by long_name rules.
+    staged = categorize_ops(str(tmp_path), rules=STAGE_RULES)
+    assert set(staged) == {"other"}  # no flagship shapes in this fixture
+    assert staged["other"]["op_count"] == 5
+    # Missing dirs yield an empty ledger, never raise.
+    assert categorize_ops(str(tmp_path / "missing")) == {}
 
 
 def test_top_device_ops_ranks_by_bytes(tmp_path):
