@@ -63,7 +63,15 @@ attribution, a >= v4-32 pod projection with $/converged-run, and
 ``model_error_ratio`` (predicted vs this run's measured median) —
 gated absolutely by compare_bench.py (--model-drift-threshold);
 BENCH_COSTMODEL=0 skips, BENCH_COSTMODEL_TOPOLOGY sets the anchor,
-BENCH_COSTMODEL_RUN_ROUNDS the $/run horizon.
+BENCH_COSTMODEL_RUN_ROUNDS the $/run horizon. The ``valuation``
+sub-object (telemetry/valuation.py) measures the streaming
+client-valuation estimator twice: its round-time ``overhead_ratio``
+against the same run's client_stats-on leg at the 1000-client
+headline, and its ``audit_spearman`` fidelity against cumulative exact
+GTG audit SVs on the small-N graded-label differential — gated
+absolutely by compare_bench.py (--valuation-corr-threshold);
+BENCH_VALUATION=0 skips, BENCH_VALUATION_ROUNDS /
+BENCH_VALUATION_FIDELITY_N/_ROUNDS set the two measurements.
 """
 
 from __future__ import annotations
@@ -521,6 +529,98 @@ def main():
             ),
             "final_accuracy": a_result["final_accuracy"],
         }
+
+    # Always-on client valuation (ISSUE 9, config.client_valuation;
+    # telemetry/valuation.py). Two measurements in one leg: (a) OVERHEAD
+    # — the SAME headline program with client_stats='on' +
+    # client_valuation='on' (no audits), overhead_ratio measured against
+    # this run's own client_stats leg so the number isolates what
+    # valuation adds ON TOP of the stats machinery it rides; (b)
+    # FIDELITY — the small-N graded-quality differential
+    # (telemetry/valuation.grade_client_labels: client i gets i/(N-1) of
+    # its labels randomized, a monotonic ground-truth quality gradient)
+    # with sparse GTG audits, recording the final audit's Spearman
+    # correlation between the streaming vector and the cumulative exact-
+    # SV estimate. compare_bench.py gates the correlation ABSOLUTELY
+    # (--valuation-corr-threshold, default 0.8 — an in-record floor like
+    # the other near-fixed-operating-point ratios, never relatively
+    # tracked). Knobs land in config_hash (at 'off' they drop out, so
+    # pre-feature hashes are unchanged — utils/reporting.config_hash).
+    # BENCH_VALUATION=0 skips; BENCH_VALUATION_ROUNDS,
+    # BENCH_VALUATION_FIDELITY_N/_ROUNDS set the two measurements.
+    run_valuation = (
+        os.environ.get("BENCH_VALUATION", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_valuation:
+        from distributed_learning_simulator_tpu.telemetry.valuation import (
+            grade_client_labels,
+        )
+
+        v_rounds = int(os.environ.get("BENCH_VALUATION_ROUNDS", "5"))
+        v_config = ExperimentConfig(
+            model_name=model, round=v_rounds + 1, client_chunk_size=chunk,
+            local_compute_dtype=dtype, client_stats="on",
+            client_valuation="on",
+            **failure_knobs, **common,
+        )
+        v_times, v_result = _run(
+            v_config, dataset=dataset, client_data=client_data
+        )
+        vr = _rates(v_times, n_clients)
+        valuation_rec = {
+            "value": round(vr["median_rate"], 2),
+            "rounds": v_rounds,
+            "round_ms": {k: round(v, 1) for k, v in vr["round_ms"].items()},
+        }
+        cs_leg = record.get("client_stats")
+        if isinstance(cs_leg, dict):
+            valuation_rec["overhead_ratio"] = round(
+                vr["round_ms"]["median"] / cs_leg["round_ms"]["median"]
+                - 1.0, 4,
+            )
+        # Fidelity: the measured differential (docs/OBSERVABILITY.md §
+        # Client valuation holds the calibration record).
+        f_n = int(os.environ.get("BENCH_VALUATION_FIDELITY_N", "8"))
+        f_rounds = int(
+            os.environ.get("BENCH_VALUATION_FIDELITY_ROUNDS", "9")
+        )
+        from distributed_learning_simulator_tpu.utils.reporting import (
+            config_hash as _chash,
+        )
+
+        f_config = ExperimentConfig(
+            dataset_name="synthetic", model_name="mlp",
+            distributed_algorithm="fed", worker_number=f_n,
+            round=f_rounds, epoch=1, learning_rate=0.1, batch_size=32,
+            n_train=1024, n_test=2048, log_level="WARNING",
+            dataset_args={"difficulty": 0.5},
+            client_stats="on", client_valuation="on",
+            valuation_audit_every=2, valuation_audit_permutations=500,
+            gtg_eps=1e-4,
+            compilation_cache_dir=common["compilation_cache_dir"],
+        )
+        f_ds = get_dataset(
+            "synthetic", n_train=1024, n_test=2048, seed=0, difficulty=0.5
+        )
+        f_cd = build_client_data(f_config, f_ds)
+        f_cd.y[:] = grade_client_labels(f_cd.y, f_ds.num_classes, seed=1)
+        _, f_result = _run(f_config, dataset=f_ds, client_data=f_cd)
+        last = (f_result["valuation"] or {}).get("last_audit") or {}
+        valuation_rec["fidelity"] = {
+            "n_clients": f_n,
+            "rounds": f_rounds,
+            "config_hash": _chash(f_config),
+            "audits": last.get("audits"),
+            "permutations": last.get("permutations"),
+            "converged": last.get("converged"),
+            "audit_pearson": last.get("pearson"),
+        }
+        # The gate's number, top-level in the leg (compare_bench.py
+        # --valuation-corr-threshold reads valuation.audit_spearman).
+        valuation_rec["audit_spearman"] = last.get("spearman")
+        record["valuation"] = valuation_rec
 
     # Streamed client residency (ISSUE 7, config.client_residency): the
     # population-scale leg. An N-sweep of synthetic populations (cohort

@@ -42,6 +42,30 @@ from distributed_learning_simulator_tpu.robustness.faults import (
 from distributed_learning_simulator_tpu.telemetry.client_stats import (
     ClientStats,
 )
+from distributed_learning_simulator_tpu.telemetry.valuation import (
+    ClientValuation,
+)
+
+
+def round_key_splits(key, with_faults: bool):
+    """The round key's split chain — the ONE copy shared by the round
+    program (resident and streamed entries), the host-side cohort replay
+    (:meth:`FedAvg.cohort_indices`), and the valuation auditor's
+    training replay (telemetry/valuation.py), so none of them can drift.
+    The extra fault split is gated so failure-free runs keep the exact
+    pre-feature RNG streams (bit-compatible histories). Returns
+    ``(part_key, train_key, payload_key, agg_key, fault_key)`` with
+    ``fault_key=None`` when no failure model is active."""
+    if with_faults:
+        part_key, train_key, payload_key, agg_key, fault_key = (
+            jax.random.split(key, 5)
+        )
+    else:
+        part_key, train_key, payload_key, agg_key = (
+            jax.random.split(key, 4)
+        )
+        fault_key = None
+    return part_key, train_key, payload_key, agg_key, fault_key
 
 
 class FedAvg(Algorithm):
@@ -193,8 +217,9 @@ class FedAvg(Algorithm):
         n_participants = cfg.cohort_size(n_clients)
         if n_participants == n_clients:
             return None
-        n_splits = 5 if FailureModel.from_config(cfg) is not None else 4
-        part_key = jax.random.split(round_key, n_splits)[0]
+        part_key = round_key_splits(
+            round_key, FailureModel.from_config(cfg) is not None
+        )[0]
         return np.asarray(
             jax.random.choice(
                 part_key, n_clients, (n_participants,), replace=False
@@ -228,6 +253,13 @@ class FedAvg(Algorithm):
         # (the default) compiles the exact pre-feature program, and 'on'
         # consumes no extra RNG, so the two modes train bit-identically.
         cs = ClientStats.from_config(cfg)
+        # Always-on client valuation (telemetry/valuation.py): like cs, a
+        # TRACE-TIME gate — client_valuation='off' (the default) compiles
+        # the exact pre-feature program (no extra output, no extra RNG);
+        # 'on' (validated to require client_stats='on') adds one tiny
+        # per-cohort score vector derived from the stats matrix the round
+        # already computes.
+        cv = ClientValuation.from_config(cfg)
         local_train = make_local_train_fn(
             apply_fn,
             optimizer,
@@ -527,22 +559,10 @@ class FedAvg(Algorithm):
             return agg, new_state, metrics_full
 
         def split_round_key(key):
-            """The round key's split chain — the ONE copy shared by the
-            resident and streamed entries AND mirrored by the host-side
-            cohort replay (FedAvg.cohort_indices), so the three can never
-            drift. The extra fault split is gated so failure-free runs
-            keep the exact pre-feature RNG streams (bit-compatible
-            histories)."""
-            if fm is not None:
-                part_key, train_key, payload_key, agg_key, fault_key = (
-                    jax.random.split(key, 5)
-                )
-            else:
-                part_key, train_key, payload_key, agg_key = (
-                    jax.random.split(key, 4)
-                )
-                fault_key = None
-            return part_key, train_key, payload_key, agg_key, fault_key
+            """Module-level ``round_key_splits`` with this build's fault
+            gating baked in (the one split-chain definition — see its
+            docstring)."""
+            return round_key_splits(key, fm is not None)
 
         def cohort_round(global_params, state_k, x_k, y_k, m_k, part_sizes,
                          idx, key, keys, lr_scale, async_state):
@@ -754,6 +774,16 @@ class FedAvg(Algorithm):
                     train_metrics,
                     cs.probe_delta(global_params, new_global),
                 )
+                if cv is not None:
+                    # Streaming valuation scores (telemetry/valuation.py):
+                    # cosine-vs-aggregate x update-norm per cohort client,
+                    # normalized to unit L1 — the in-program half of the
+                    # estimator; the host folds in the server loss-delta
+                    # and the exponential decay. Derived from the stats
+                    # matrix above, so it shares the probe, the
+                    # post-corruption measurement point, and the
+                    # fused/bucketed/materializing-path parity for free.
+                    aux["valuation_scores"] = cv.scores(aux["client_stats"])
             if quorum:
                 # Quorum policy: a round is REJECTED — previous global
                 # retained, the event recorded — when honest survivors fall
@@ -895,6 +925,81 @@ class FedAvg(Algorithm):
             return new_global, new_state_k, aux
 
         return round_fn_streamed
+
+    def make_valuation_audit_fn(self, apply_fn, optimizer, preprocess=None):
+        """Build the valuation auditor's cohort-stack replay program.
+
+        ``audit_stack(global_params, x_k, y_k, m_k, client_keys,
+        payload_key, lr_scale) -> [cohort, ...] payload-processed
+        params`` — the EXACT per-client uploads the round aggregated,
+        re-materialized for the truncated GTG audit walk
+        (telemetry/valuation.py). The replay trains the cohort from the
+        round's pre-round global params with the same per-client keys
+        (``round_key_splits``' train_key fan-out — the caller derives
+        them host-side) and the same local-train build knobs; the only
+        difference from the live round is ``collect_stats=False``, which
+        changes metric outputs, never the trained params (the PR 4
+        off-gate contract). Audit preconditions (plain ``fed`` only, no
+        faults, no async, no persistent client state —
+        config.validate()) keep the replay this simple AND exact:
+        ``process_client_payload`` is fed's identity here (fed_quant is
+        refused — its live fused path quantizes with per-chunk payload
+        keys that a whole-stack replay cannot reproduce), so the
+        replayed stack is bit-for-bit the uploads the round aggregated.
+        """
+        from distributed_learning_simulator_tpu.ops.augment import get_augment
+
+        cfg = self.config
+        compute_dtype = None
+        if getattr(cfg, "local_compute_dtype", "float32") == "bfloat16":
+            compute_dtype = jnp.bfloat16
+        local_train = make_local_train_fn(
+            apply_fn,
+            optimizer,
+            local_epochs=cfg.epoch,
+            batch_size=cfg.batch_size,
+            param_transform=self.client_param_transform(),
+            reset_optimizer=cfg.reset_client_optimizer,
+            preprocess=preprocess,
+            augment=get_augment(cfg.augment),
+            compute_dtype=compute_dtype,
+            collect_stats=False,
+        )
+        vtrain = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0, None))
+        chunk = cfg.client_chunk_size
+
+        def audit_stack(global_params, x_k, y_k, m_k, client_keys,
+                        payload_key, lr_scale=1.0):
+            if chunk is None or chunk >= client_keys.shape[0]:
+                cp, _, _ = vtrain(
+                    global_params, None, x_k, y_k, m_k, client_keys,
+                    lr_scale,
+                )
+            else:
+                # Same memory envelope as the round itself: chunk clients
+                # in flight (lax.map's batch_size), never the whole
+                # cohort's training transients at once.
+                def one_client(args):
+                    xi, yi, mi, k = args
+                    cp_i, _, _ = local_train(
+                        global_params, None, xi, yi, mi, k, lr_scale
+                    )
+                    return cp_i
+
+                cp = jax.lax.map(
+                    one_client, (x_k, y_k, m_k, client_keys),
+                    batch_size=chunk,
+                )
+            if compute_dtype is not None:
+                # The subset evaluator consumes the stack like the
+                # materializing round path does: f32.
+                cp = jax.tree_util.tree_map(
+                    lambda p: p.astype(jnp.float32), cp
+                )
+            cp, _ = self.process_client_payload(cp, payload_key)
+            return cp
+
+        return audit_stack
 
     def client_param_transform(self):
         """Param transform inside the client loss (QAT hook; None here)."""

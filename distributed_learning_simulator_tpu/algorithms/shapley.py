@@ -59,10 +59,240 @@ from distributed_learning_simulator_tpu.telemetry.client_stats import (
     ClientStats,
     attribution_crosscheck,
 )
+from distributed_learning_simulator_tpu.telemetry.valuation import (
+    cohort_crc,
+)
 from distributed_learning_simulator_tpu.utils.logging import get_logger
 
 _EVAL_CHUNK = 16  # subset models evaluated per batched XLA call
 _PREFIX_BLOCK = 16  # GTG permutation prefixes fetched per fused call
+
+
+class SubsetMemo(dict):
+    """Subset-utility memo with cross-round reuse accounting.
+
+    A plain dict everywhere the walk machinery is concerned (it only does
+    ``s in memo`` / ``memo[s]`` / ``memo[s] = v``), plus bookkeeping for
+    the cross-round reuse feature (``config.gtg_cross_round_memo``,
+    ROADMAP item 4b): entries present at construction are the SEED —
+    utilities carried over from an earlier round with the same cohort —
+    and :meth:`hit_rate` reports what fraction of the subsets this walk
+    actually requested were served from the seed instead of evaluated.
+    Reused utilities describe the *earlier* round's client params; the
+    reuse premise (GTG-Shapley's between-round truncation) is that subset
+    utilities drift slowly once the model converges — the regime where
+    round truncation fires anyway. The hit rate (and, for audit walks,
+    the recorded fidelity correlation) is the self-policing measurement
+    of that premise.
+
+    What a hit SAVES depends on the prefix mode: under ``masked`` the
+    deduplication in :func:`eval_subsets` skips the seeded subsets'
+    evaluator calls outright (realized device savings); under the
+    default ``cumsum`` the prefix walker must stream every position to
+    maintain its carries, so a seeded prefix is still computed inside
+    the fused wave and only its memo write is skipped — the hit rate
+    then measures utility REUSE/stability, not device work avoided
+    (the same caveat the walker's own docstring makes for within-round
+    hits).
+    """
+
+    def __init__(self, seed: dict | None = None):
+        super().__init__(seed or {})
+        self._seeded = frozenset(self)
+        self._hits: set = set()
+        self._inserted = 0
+
+    def __contains__(self, key) -> bool:
+        present = super().__contains__(key)
+        if present and key in self._seeded:
+            self._hits.add(key)
+        return present
+
+    def __setitem__(self, key, value) -> None:
+        if not super().__contains__(key):
+            self._inserted += 1
+        super().__setitem__(key, value)
+
+    @property
+    def evaluated(self) -> int:
+        """Subsets actually evaluated into this memo (seeded entries
+        excluded) — the honest ``gtg_subset_evals`` cost unit; equals
+        ``len(self)`` when unseeded."""
+        return self._inserted
+
+    def hit_rate(self) -> float | None:
+        """Fraction of requested subsets served from the cross-round seed
+        (None when the walk requested nothing)."""
+        requested = len(self._hits) + self._inserted
+        if requested == 0:
+            return None
+        return len(self._hits) / requested
+
+
+def eval_subsets(evaluator, client_params, sizes, prev_global,
+                 eval_batches, n: int, memo, subset_sets) -> None:
+    """Evaluate the listed subsets (frozensets of client indices) into
+    ``memo``, deduplicating against it — the ONE mask-building path shared
+    by the masked walk mode, the grand/empty-coalition seeds, and the
+    valuation auditor (telemetry/valuation.py)."""
+    todo = list(dict.fromkeys(s for s in subset_sets if s not in memo))
+    if not todo:
+        return
+    mask_rows = np.zeros((len(todo), n), dtype=np.float32)
+    for r, s in enumerate(todo):
+        mask_rows[r, list(s)] = 1.0
+    vals = evaluator(
+        client_params, sizes, mask_rows, prev_global, eval_batches
+    )
+    for s, v in zip(todo, vals):
+        memo[s] = float(v)
+
+
+def _gtg_converged(records: list[np.ndarray], n: int, last_k: int,
+                   converge_criteria: float) -> bool:
+    converge_min = max(30, n)  # GTG_shapley_value_server.py:15
+    # last_k + 1 records minimum: with a configurable last_k above the
+    # reference's 30-record floor, running_means[-last_k:] would silently
+    # truncate and a mean flat over fewer samples than the user asked to
+    # compare could fire convergence early.
+    if len(records) <= max(converge_min, last_k):
+        return False
+    # Reference semantics (GTG_shapley_value_server.py:82-91): each of
+    # the last_k running means is compared to the FINAL running mean —
+    # relative error averaged over the worker axis — and sampling stops
+    # when the largest of those k errors is within converge_criteria.
+    # (NOT successive diffs: a running mean drifting steadily has small
+    # per-step changes but large distance-to-final, and the reference
+    # keeps sampling in that regime.) Note the last_k window INCLUDES
+    # the final mean itself (its error is trivially 0, so last_k-1
+    # comparisons are informative) — that is the reference's exact
+    # slice, kept verbatim for parity.
+    all_arr = np.stack(records)
+    cumsum = np.cumsum(all_arr, axis=0)
+    counts = np.arange(1, len(records) + 1)[:, None]
+    running_means = (cumsum / counts)[-last_k:]
+    final = running_means[-1:]
+    errors = np.mean(
+        np.abs(running_means - final) / (np.abs(final) + 1e-12), axis=1
+    )
+    return bool(np.max(errors) <= converge_criteria)
+
+
+def gtg_walk(evaluator, client_params, sizes, prev_global, eval_batches,
+             n: int, rng, *, eps: float, cap: int, last_k: int,
+             converge_criteria: float, trunc_ref: float,
+             prefix_mode: str = "cumsum", memo=None,
+             starts_per_iteration: int | None = None):
+    """One round's GTG permutation-sampling walk over an ``n``-client
+    cohort: Monte-Carlo marginal records with eps-truncation, shared
+    waves, and the cross-walk subset memo.
+
+    Extracted from ``GTGShapley.post_round`` so the valuation auditor
+    (telemetry/valuation.py) runs the EXACT same estimator on the current
+    round's cohort — one walk implementation, no drift between the
+    offline scorer and the in-line audit. Returns
+    ``(sv_arr, n_perms, converged)``; utilities accumulate into ``memo``
+    (a fresh dict when None — pass a :class:`SubsetMemo` seeded from an
+    earlier round for cross-round reuse).
+
+    ``starts_per_iteration`` truncates a sampling iteration to that many
+    permutations (first elements drawn without replacement from ``rng``
+    instead of "one per worker") — the audit walk's budget knob; None
+    keeps the reference's one-permutation-per-worker iteration.
+    """
+    if memo is None:
+        memo = {}
+    eval_subsets(
+        evaluator, client_params, sizes, prev_global, eval_batches, n,
+        memo, [frozenset()],
+    )  # u(empty): every walk's starting value
+    walker = None
+    if prefix_mode == "cumsum":
+        walker = _CumsumPrefixWalker(
+            evaluator, client_params, sizes, prev_global, eval_batches, n,
+        )
+    records: list[np.ndarray] = []
+    n_perms = 0
+    converged = False
+    while not converged and n_perms < cap:
+        # One permutation starting with each worker (:42-49) — or, for a
+        # budgeted audit walk, with each of a sampled subset of workers.
+        # The whole sampling iteration is evaluated in shared WAVES: wave
+        # w requests prefix block [wB, wB+B) for EVERY still-active
+        # permutation in one batched evaluator call (the memo dedups
+        # shared prefixes), instead of walking the permutations one at a
+        # time — at N=128 this cuts the sequential host dispatch+fetch
+        # cycles per iteration from O(n * n/B) to n/B. The
+        # per-permutation walk (eps-truncation semantics :51-61,
+        # truncated step keeps v_prev so its marginal is exactly 0) is
+        # unchanged, so within one sampling iteration the records — and
+        # therefore SVs, permutation counts and the convergence point —
+        # match a sequential walk over the same permutations. Two
+        # bookkeeping differences vs walking one permutation at a time:
+        # prefixes evaluated past a mid-iteration convergence are extra
+        # (they land in the memo/metric pickle), and all shuffles are
+        # drawn up front, so on mid-iteration convergence the RNG stream
+        # position differs from a lazily-drawing walk (later rounds
+        # sample different — equally valid — permutations).
+        if starts_per_iteration is None or starts_per_iteration >= n:
+            starts = list(range(n))
+        else:
+            starts = [
+                int(s) for s in
+                rng.choice(n, size=starts_per_iteration, replace=False)
+            ]
+        m = len(starts)
+        perms = []
+        for first in starts:
+            rest = [i for i in range(n) if i != first]
+            rng.shuffle(rest)
+            perms.append([first] + rest)
+        if walker is not None:
+            walker.reset()  # fresh zero carries for this iteration
+        marginals = np.zeros((m, n), dtype=np.float64)
+        v_prev = [memo[frozenset()]] * m
+        truncated = [False] * m
+        for j0 in range(0, n, _PREFIX_BLOCK):
+            j1 = min(j0 + _PREFIX_BLOCK, n)
+            active: list[int] = []
+            for p_idx in range(m):
+                if truncated[p_idx] or (
+                    abs(trunc_ref - v_prev[p_idx]) < eps
+                ):
+                    truncated[p_idx] = True
+                else:
+                    active.append(p_idx)
+            if not active:
+                break  # every permutation truncated
+            if walker is not None:
+                walker.eval_block(perms, active, j0, j1, memo)
+            else:
+                eval_subsets(
+                    evaluator, client_params, sizes, prev_global,
+                    eval_batches, n, memo,
+                    [
+                        frozenset(perms[p][: j + 1])
+                        for p in active for j in range(j0, j1)
+                    ],
+                )
+            for p_idx in active:
+                perm = perms[p_idx]
+                vp = v_prev[p_idx]
+                for j in range(j0, j1):
+                    if abs(trunc_ref - vp) >= eps:
+                        v_j = memo[frozenset(perm[: j + 1])]
+                    else:
+                        v_j = vp  # truncated: marginal exactly 0
+                    marginals[p_idx, perm[j]] = v_j - vp
+                    vp = v_j
+                v_prev[p_idx] = vp
+        for p_idx in range(m):
+            records.append(marginals[p_idx].copy())  # SURVEY 2.1#10
+            n_perms += 1
+            if _gtg_converged(records, n, last_k, converge_criteria):
+                converged = True
+                break
+    return np.mean(np.stack(records), axis=0), n_perms, converged
 
 
 def _sv_crosscheck_extra(ctx: RoundContext, sv_arr, config) -> dict:
@@ -633,6 +863,11 @@ class GTGShapley(FedAvg):
         # never produce a converged estimate — it silently degrades to a
         # one-iteration Monte-Carlo run (VERDICT r4 weak #2).
         self.max_permutations = getattr(config, "gtg_max_permutations", None)
+        # Cross-round subset-utility reuse (config.gtg_cross_round_memo):
+        # {cohort crc32 -> the last walk's utility dict}; the latest
+        # round's values replace older ones (freshest params win).
+        self._memo_store: dict[int, dict] = {}
+        self.gtg_memo_hit_rate: float | None = None
         if (
             self.max_permutations is not None
             and self.max_permutations < config.worker_number
@@ -692,32 +927,9 @@ class GTGShapley(FedAvg):
         )
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
-        converge_min = max(30, n)  # GTG_shapley_value_server.py:15
-        # last_k + 1 records minimum: with a configurable last_k above the
-        # reference's 30-record floor, running_means[-last_k:] would silently
-        # truncate and a mean flat over fewer samples than the user asked to
-        # compare could fire convergence early.
-        if len(records) <= max(converge_min, self.last_k):
-            return False
-        # Reference semantics (GTG_shapley_value_server.py:82-91): each of
-        # the last_k running means is compared to the FINAL running mean —
-        # relative error averaged over the worker axis — and sampling stops
-        # when the largest of those k errors is within converge_criteria.
-        # (NOT successive diffs: a running mean drifting steadily has small
-        # per-step changes but large distance-to-final, and the reference
-        # keeps sampling in that regime.) Note the last_k window INCLUDES
-        # the final mean itself (its error is trivially 0, so last_k-1
-        # comparisons are informative) — that is the reference's exact
-        # slice, kept verbatim for parity.
-        all_arr = np.stack(records)
-        cumsum = np.cumsum(all_arr, axis=0)
-        counts = np.arange(1, len(records) + 1)[:, None]
-        running_means = (cumsum / counts)[-self.last_k :]
-        final = running_means[-1:]
-        errors = np.mean(
-            np.abs(running_means - final) / (np.abs(final) + 1e-12), axis=1
-        )
-        return bool(np.max(errors) <= self.converge_criteria)
+        # Thin delegate: the convergence rule lives in _gtg_converged so
+        # gtg_walk (and the valuation auditor riding it) shares it.
+        return _gtg_converged(records, n, self.last_k, self.converge_criteria)
 
     def post_round(self, ctx: RoundContext) -> dict:
         n = int(ctx.sizes.shape[0])
@@ -737,30 +949,37 @@ class GTGShapley(FedAvg):
             return {"shapley_values": sv, "gtg_permutations": 0}
 
         client_params = self._evaluator.prepare_stack(ctx.aux["client_params"])
-        memo: dict[frozenset, float] = {}
+        # Cross-round memo (config.gtg_cross_round_memo, ROADMAP item 4b):
+        # seed this round's subset-utility memo from the last round with
+        # the SAME cohort (GTG requires full participation, so the cohort
+        # — and its hash — is constant across rounds). Off (the default)
+        # keeps the exact pre-feature per-round memo. Reused utilities
+        # describe the earlier round's params (SubsetMemo docstring);
+        # the recorded hit rate measures how much was reused.
+        cohort_key = cohort_crc(None, n)
+        cross_round = bool(
+            getattr(self.config, "gtg_cross_round_memo", False)
+        )
+        seed = self._memo_store.get(cohort_key) if cross_round else None
+        if seed:
+            # The empty and grand coalitions anchor the walk (every
+            # v_prev chain and the eps-truncation reference) — always
+            # re-evaluate them against THIS round's params; only interior
+            # subsets are reuse candidates.
+            seed = {
+                k: v for k, v in seed.items() if 0 < len(k) < n
+            }
+        memo = SubsetMemo(seed)
         eval_batches = cap_eval_batches(
             ctx.eval_batches,
             getattr(self.config, "shapley_eval_samples", None),
         )
 
         def utilities_for(masks_sets: list[frozenset]) -> None:
-            # dict.fromkeys: wave batching legitimately requests the same
-            # prefix from many permutations (e.g. every permutation's full
-            # set) — evaluate each subset once, not once per requester.
-            todo = list(dict.fromkeys(
-                s for s in masks_sets if s not in memo
-            ))
-            if not todo:
-                return
-            mask_rows = np.zeros((len(todo), n), dtype=np.float32)
-            for r, s in enumerate(todo):
-                mask_rows[r, list(s)] = 1.0
-            vals = self._evaluator(
-                client_params, ctx.sizes, mask_rows,
-                ctx.prev_global_params, eval_batches,
+            eval_subsets(
+                self._evaluator, client_params, ctx.sizes,
+                ctx.prev_global_params, eval_batches, n, memo, masks_sets,
             )
-            for s, v in zip(todo, vals):
-                memo[s] = float(v)
 
         utilities_for([frozenset()])  # u(empty) = prev-global metric
         # eps-truncation reference: "running value close to the full-
@@ -793,93 +1012,37 @@ class GTGShapley(FedAvg):
                 "iteration alone draws N permutations; the cap will be "
                 "exceeded and convergence cannot fire", cap, n,
             )
-        # Prefix-aggregation mode (config.gtg_prefix_mode): 'cumsum' (the
-        # default) streams each permutation's weighted running sum block by
-        # block and takes every prefix model from an O(P) slice of it;
-        # 'masked' is the original per-prefix mask-weighted reduction over
-        # the full stack, kept as the bit-level oracle
+        # The walk itself — permutation sampling, shared waves,
+        # eps-truncation, convergence — is module-level ``gtg_walk``
+        # (shared verbatim with the valuation auditor,
+        # telemetry/valuation.py). Prefix-aggregation mode
+        # (config.gtg_prefix_mode): 'cumsum' (the default) streams each
+        # permutation's weighted running sum block by block; 'masked' is
+        # the per-prefix mask-weighted oracle
         # (tests/test_shapley.py::test_gtg_prefix_mode_equivalence). Both
-        # modes share the RNG stream, the wave structure, the memo, and the
-        # truncation/marginal bookkeeping below, so a fixed seed yields the
+        # modes share the RNG stream, the wave structure, the memo, and
+        # the truncation/marginal bookkeeping, so a fixed seed yields the
         # same permutations and — utilities agreeing — identical records.
-        mode = getattr(self.config, "gtg_prefix_mode", "cumsum")
-        walker = None
-        if mode == "cumsum":
-            walker = _CumsumPrefixWalker(
-                self._evaluator, client_params, ctx.sizes,
-                ctx.prev_global_params, eval_batches, n,
-            )
-        records: list[np.ndarray] = []
-        n_perms = 0
-        converged = False
-        while not converged and n_perms < cap:
-            # One permutation starting with each worker (:42-49). The whole
-            # sampling iteration is evaluated in shared WAVES: wave w
-            # requests prefix block [wB, wB+B) for EVERY still-active
-            # permutation in one batched evaluator call (the memo dedups
-            # shared prefixes), instead of walking the n permutations one
-            # at a time — at N=128 this cuts the sequential host
-            # dispatch+fetch cycles per iteration from O(n * n/B) to n/B.
-            # The per-permutation walk (eps-truncation semantics :51-61,
-            # truncated step keeps v_prev so its marginal is exactly 0) is
-            # unchanged, so within one sampling iteration the records — and
-            # therefore SVs, permutation counts and the convergence point —
-            # match a sequential walk over the same permutations. Two
-            # bookkeeping differences vs walking one permutation at a time:
-            # prefixes evaluated past a mid-iteration convergence are extra
-            # (they land in the memo/metric pickle), and all n shuffles are
-            # drawn up front, so on mid-iteration convergence the RNG
-            # stream position differs from a lazily-drawing walk (later
-            # rounds sample different — equally valid — permutations).
-            perms = []
-            for first in range(n):
-                rest = [i for i in range(n) if i != first]
-                self._rng.shuffle(rest)
-                perms.append([first] + rest)
-            if walker is not None:
-                walker.reset()  # fresh zero carries for this iteration
-            marginals = np.zeros((n, n), dtype=np.float64)
-            v_prev = [memo[frozenset()]] * n
-            truncated = [False] * n
-            for j0 in range(0, n, _PREFIX_BLOCK):
-                j1 = min(j0 + _PREFIX_BLOCK, n)
-                active: list[int] = []
-                for p_idx in range(n):
-                    if truncated[p_idx] or (
-                        abs(trunc_ref - v_prev[p_idx]) < self.eps
-                    ):
-                        truncated[p_idx] = True
-                    else:
-                        active.append(p_idx)
-                if not active:
-                    break  # every permutation truncated
-                if walker is not None:
-                    walker.eval_block(perms, active, j0, j1, memo)
-                else:
-                    utilities_for([
-                        frozenset(perms[p][: j + 1])
-                        for p in active for j in range(j0, j1)
-                    ])
-                for p_idx in active:
-                    perm = perms[p_idx]
-                    vp = v_prev[p_idx]
-                    for j in range(j0, j1):
-                        if abs(trunc_ref - vp) >= self.eps:
-                            v_j = memo[frozenset(perm[: j + 1])]
-                        else:
-                            v_j = vp  # truncated: marginal exactly 0
-                        marginals[p_idx, perm[j]] = v_j - vp
-                        vp = v_j
-                    v_prev[p_idx] = vp
-            for p_idx in range(n):
-                records.append(marginals[p_idx].copy())  # SURVEY 2.1#10
-                n_perms += 1
-                if self._converged(records, n):
-                    converged = True
-                    break
-        sv_arr = np.mean(np.stack(records), axis=0)
+        sv_arr, n_perms, converged = gtg_walk(
+            self._evaluator, client_params, ctx.sizes,
+            ctx.prev_global_params, eval_batches, n, self._rng,
+            eps=self.eps, cap=cap, last_k=self.last_k,
+            converge_criteria=self.converge_criteria, trunc_ref=trunc_ref,
+            prefix_mode=getattr(self.config, "gtg_prefix_mode", "cumsum"),
+            memo=memo,
+        )
         sv = {i: float(v) for i, v in enumerate(sv_arr)}
         self.shapley_values[round_idx] = sv
+        memo_extra = {}
+        if cross_round:
+            self._memo_store[cohort_key] = dict(memo)
+            self.gtg_memo_hit_rate = memo.hit_rate()
+            if self.gtg_memo_hit_rate is not None:
+                # ROADMAP item 4b's tracked number: what fraction of this
+                # walk's subset utilities earlier rounds already paid for.
+                memo_extra["gtg_memo_hit_rate"] = round(
+                    self.gtg_memo_hit_rate, 4
+                )
         if ctx.log_dir:
             path = os.path.join(ctx.log_dir, f"metric_{round_idx}.pkl")
             with open(path, "wb") as f:
@@ -889,15 +1052,19 @@ class GTGShapley(FedAvg):
         logger.info(
             "round %d shapley values (GTG, %d permutations, %d subset evals, "
             "converged=%s): %s",
-            round_idx, n_perms, len(memo), converged, sv,
+            round_idx, n_perms, memo.evaluated, converged, sv,
         )
         return {
             "shapley_values": sv,
             "gtg_permutations": n_perms,
-            "gtg_subset_evals": len(memo),
+            # Evaluations THIS round paid for: cross-round memo hits are
+            # excluded (they are the saving, not the cost); equals the
+            # memo size exactly when gtg_cross_round_memo is off.
+            "gtg_subset_evals": memo.evaluated,
             # Tracked by bench.py's gtg leg / scripts/measure_gtg_scale.py:
             # a converged round is the honest cost unit (a fixed-budget
             # Monte-Carlo round is cheaper but a different estimator).
             "gtg_converged": converged,
+            **memo_extra,
             **_sv_crosscheck_extra(ctx, sv_arr, self.config),
         }

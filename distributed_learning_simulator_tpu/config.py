@@ -387,6 +387,50 @@ class ExperimentConfig:
     # Robust z-score threshold of the median/MAD detector; lower = more
     # sensitive (see docs/OBSERVABILITY.md § detector tuning).
     client_stats_mad_threshold: float = 8.0
+    # --- always-on client valuation (telemetry/valuation.py) ----------------
+    # "off" (default): zero instrumentation — the round program is the
+    # exact pre-feature program and metrics.jsonl records stay at schema
+    # v6 or below. "on" (requires client_stats='on'; FedAvg family, vmap
+    # execution): the round additionally emits a per-cohort streaming
+    # contribution score (cosine-vs-aggregate x update-norm over the
+    # client-stats probe, unit-L1 normalized) that the host scales by the
+    # server loss-delta and folds into a persistent exponentially-decayed
+    # per-client valuation vector — a cheap always-on Shapley proxy
+    # (schema-v7 ``valuation`` sub-object; docs/OBSERVABILITY.md
+    # § Client valuation).
+    client_valuation: str = "off"
+    # Exponential decay of the valuation fold: participants' entries move
+    # v <- decay * v + (1 - decay) * loss_delta * score each round.
+    # Higher = longer memory.
+    valuation_decay: float = 0.9
+    # Audit cadence: every this-many rounds (0 = never) the simulator
+    # re-materializes the current cohort's exact uploads (round-key
+    # replay) and runs a truncated GTG walk over them
+    # (algorithms/shapley.gtg_walk), recording Spearman/Pearson
+    # correlation between the streaming vector and the exact SVs — the
+    # measured fidelity bound on the cheap estimator. Audits are pure
+    # reads (training is untouched) and cost roughly one extra cohort
+    # training pass + the walk; they refuse failure models, async mode,
+    # non-mean aggregation, persistent client optimizers, mesh/multihost,
+    # and rounds_per_dispatch > 1 (the replay's exactness contract).
+    valuation_audit_every: int = 0
+    # Permutation budget per audit walk (also the number of permutations
+    # drawn per truncated sampling iteration). Small-N audits converge
+    # within the auto GTG cap; at large N this bounds the walk.
+    valuation_audit_permutations: int = 16
+    # GTG cross-round subset-utility memo (ROADMAP item 4b): reuse
+    # interior subset utilities from the last walk over the SAME cohort
+    # (GTG-Shapley's between-round reuse premise: utilities drift slowly
+    # once round truncation fires). Off (default) keeps the exact
+    # per-round memo semantics; the walk's gtg_memo_hit_rate records how
+    # much was reused when on. Realized device savings require
+    # gtg_prefix_mode='masked' (its per-subset calls dedup against the
+    # seed); under the default 'cumsum' the prefix walker streams every
+    # position to keep its carries, so the hit rate measures utility
+    # reuse/stability, not work avoided (algorithms/shapley.SubsetMemo).
+    # Also governs whether valuation audits seed from the previous audit
+    # of the same cohort.
+    gtg_cross_round_memo: bool = False
     # Write a jax.profiler trace of the whole run into this directory.
     profile_dir: str | None = None
     # First round the profile trace covers (earlier rounds run untraced).
@@ -684,6 +728,104 @@ class ExperimentConfig:
             raise ValueError("client_stats_probe must be >= 1")
         if self.client_stats_mad_threshold <= 0.0:
             raise ValueError("client_stats_mad_threshold must be > 0")
+        if self.client_valuation.lower() not in ("off", "on"):
+            raise ValueError(
+                f"unknown client_valuation {self.client_valuation!r}; "
+                "known: off, on"
+            )
+        if not 0.0 <= self.valuation_decay < 1.0:
+            raise ValueError("valuation_decay must be in [0, 1)")
+        if self.valuation_audit_every < 0:
+            raise ValueError("valuation_audit_every must be >= 0")
+        if self.valuation_audit_permutations < 1:
+            raise ValueError("valuation_audit_permutations must be >= 1")
+        if self.client_valuation.lower() == "on":
+            if self.client_stats.lower() != "on":
+                # The streaming scores are DERIVED from the client-stats
+                # matrix (telemetry/valuation.py) — valuation without the
+                # stats machinery has nothing to score.
+                raise ValueError(
+                    "client_valuation='on' requires client_stats='on' "
+                    "(the streaming scores derive from the per-client "
+                    "stats matrix)"
+                )
+            if self.execution_mode.lower() == "threaded":
+                raise ValueError(
+                    "client_valuation='on' requires the vmap execution "
+                    "mode (the threaded oracle computes no in-round "
+                    "score vector)"
+                )
+            if self.distributed_algorithm == "sign_SGD":
+                # sign_SGD keeps one shared params tree — there is no
+                # per-client update delta to score.
+                raise ValueError(
+                    "client_valuation='on' is not supported for sign_SGD "
+                    "(no per-client update delta to score)"
+                )
+        if self.valuation_audit_every > 0:
+            # The audit replays the cohort's local training exactly from
+            # the round key; every condition below would make the replay
+            # (or the subset-utility semantics) diverge from the live
+            # round — refuse with the cause, never audit garbage.
+            if self.client_valuation.lower() != "on":
+                raise ValueError(
+                    "valuation_audit_every > 0 requires "
+                    "client_valuation='on' (there is no streaming vector "
+                    "to audit)"
+                )
+            if self.distributed_algorithm != "fed":
+                # fed_quant is deliberately excluded: the live fused
+                # path quantizes uploads with PER-CHUNK payload keys
+                # (chunked_accumulate per_chunk / the bucketed group
+                # split), which a whole-stack replay cannot reproduce —
+                # the audit would score re-quantized uploads the server
+                # never saw. The Shapley servers already compute exact
+                # SVs; sign_SGD has no per-client delta.
+                raise ValueError(
+                    "valuation audits support distributed_algorithm="
+                    f"'fed' only, not {self.distributed_algorithm!r} "
+                    "(fed_quant's per-chunk upload-quantization keys "
+                    "cannot be replayed exactly on a whole-stack audit; "
+                    "the Shapley servers already compute exact SVs)"
+                )
+            if self.failure_mode != "none" and self.failure_prob > 0.0:
+                raise ValueError(
+                    "valuation audits refuse failure injection (the "
+                    "cohort replay assumes honest uploads, the same "
+                    "contract as Shapley scoring); set failure_mode="
+                    "'none' or valuation_audit_every=0"
+                )
+            if self.async_mode.lower() == "on":
+                raise ValueError(
+                    "valuation audits refuse async_mode='on' (subset "
+                    "utilities assume a synchronous cohort); set "
+                    "valuation_audit_every=0"
+                )
+            if self.aggregation.lower() != "mean":
+                raise ValueError(
+                    "valuation audits assume the weighted-mean "
+                    "aggregator (subset utilities are weighted means); "
+                    "set aggregation='mean' or valuation_audit_every=0"
+                )
+            if not self.reset_client_optimizer:
+                raise ValueError(
+                    "valuation audits require reset_client_optimizer="
+                    "True (the replay cannot reconstruct pre-round "
+                    "persistent optimizer state)"
+                )
+            if self.rounds_per_dispatch > 1:
+                raise ValueError(
+                    "valuation audits require rounds_per_dispatch=1 "
+                    "(the audit replays one round's key chain against "
+                    "that round's pre-round global params)"
+                )
+            if self.multihost or (
+                self.mesh_devices is not None and self.mesh_devices > 1
+            ):
+                raise ValueError(
+                    "valuation audits do not compose with mesh/multihost "
+                    "sharding; run audits on a single device"
+                )
         if self.profile_from_round < 0:
             raise ValueError(
                 f"profile_from_round must be >= 0, got "

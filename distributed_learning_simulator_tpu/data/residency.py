@@ -84,6 +84,11 @@ class HostShardStore:
         self.mask = np.ascontiguousarray(mask)
         self.sizes = np.ascontiguousarray(sizes)
         self.state = state
+        # Per-client valuation vector (telemetry/valuation.py): attached
+        # by ValuationState when client_valuation='on' under streamed
+        # residency, so the store stays the ONE owner of every full-N
+        # per-client array between dispatches. None otherwise.
+        self.valuation = None
         n = self.x.shape[0]
         if not (self.y.shape[0] == self.mask.shape[0]
                 == self.sizes.shape[0] == n):
@@ -164,6 +169,18 @@ class HostShardStore:
             return full
 
         self.state = tree_map_np(put, self.state, cohort_state)
+
+    def attach_valuation(self, values) -> None:
+        """Adopt the per-client valuation vector (telemetry/valuation.py)
+        as a store-owned full-N array — length-checked like every other
+        client-axis array the store holds."""
+        values = np.asarray(values, dtype=np.float64)
+        if values.shape != (self.n_clients,):
+            raise ValueError(
+                f"valuation vector has shape {values.shape}, store has "
+                f"{self.n_clients} clients"
+            )
+        self.valuation = values
 
     def data_bytes(self) -> int:
         """Host bytes of the full-N data shards."""

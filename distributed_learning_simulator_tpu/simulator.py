@@ -69,7 +69,10 @@ from distributed_learning_simulator_tpu.robustness.arrivals import (
 from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
 from distributed_learning_simulator_tpu.telemetry import (
     ClientStats,
+    ClientValuation,
     RecompileMonitor,
+    ValuationAuditor,
+    ValuationState,
     costmodel_record,
     detect_and_record,
     hbm_limit_bytes,
@@ -77,6 +80,7 @@ from distributed_learning_simulator_tpu.telemetry import (
     log_round_compiles,
     make_phase_timer,
     peak_hbm_bytes,
+    valuation_record,
 )
 from distributed_learning_simulator_tpu.utils.reporting import (
     build_round_record,
@@ -324,13 +328,16 @@ class _StackedAuxRow(Mapping):
 
 
 def _algo_checkpoint_state(algorithm, metrics, server_state,
-                           async_state=None) -> dict:
+                           async_state=None, valuation=None) -> dict:
     """Assemble the checkpoint's ``algo_state`` dict — the ONE copy shared
     by the round-loop checkpoint cadence, the batched-dispatch flush, and
     the SIGTERM force-write path (the copies were one field away from
     drifting). ``async_state`` is the staleness-buffer carry
     (robustness/arrivals.py) — persisted so an async resume replays the
-    buffer bit-exactly, absent entirely for synchronous runs."""
+    buffer bit-exactly, absent entirely for synchronous runs.
+    ``valuation`` is the streaming per-client valuation vector
+    (telemetry/valuation.py) — persisted so a resumed run keeps its
+    accumulated contribution evidence; absent when the feature is off."""
     algo_state = {"prev_metrics": metrics}
     if hasattr(algorithm, "shapley_values"):
         algo_state["shapley_values"] = algorithm.shapley_values
@@ -338,6 +345,8 @@ def _algo_checkpoint_state(algorithm, metrics, server_state,
         algo_state["server_opt_state"] = jax.device_get(server_state)
     if async_state is not None:
         algo_state["async_state"] = jax.device_get(async_state)
+    if valuation is not None:
+        algo_state["valuation"] = np.asarray(valuation)
     return algo_state
 
 
@@ -725,6 +734,10 @@ def run_simulation(
     # --- resume (before placement, so restored state gets sharded too) ------
     start_round = 0
     prev_metrics: dict | None = None
+    # Streaming valuation vector saved by an earlier run (applied after
+    # placement, once the ValuationState — and, under streamed
+    # residency, its host-store home — exists).
+    resumed_valuation = None
     key = jax.random.key(config.seed + 1)
     if streamed:
         # Host-side init: the full-N state tree must never be built as a
@@ -850,6 +863,7 @@ def run_simulation(
                 algorithm.shapley_values.update(
                     ckpt["algo_state"].get("shapley_values", {})
                 )
+            resumed_valuation = ckpt["algo_state"].get("valuation")
             logger.info("resumed from %s at round %d", ckpt_path, start_round)
         else:
             resumed_basename = ""
@@ -1048,6 +1062,38 @@ def run_simulation(
     # the schema-v3 record. None at the default 'off'.
     client_stats_cfg = ClientStats.from_config(config)
     telemetry["clients_flagged"] = 0
+    # Always-on client valuation (telemetry/valuation.py): the round
+    # program emits a per-cohort streaming score vector (riding the
+    # client-stats machinery); the host scales it by the server
+    # loss-delta and folds it into the persistent exponentially-decayed
+    # per-client valuation vector — a host numpy [N] array (attached to
+    # the streamed host store when one exists, so the store stays the
+    # one owner of full-N arrays), scatter-updated per cohort and
+    # checkpointed in algo_state. On the sparse valuation_audit_every
+    # cadence the auditor cross-validates the vector against a truncated
+    # GTG walk over the round's exact re-materialized uploads. None at
+    # the default 'off' — records stay at schema v6 or below.
+    valuation_cfg = ClientValuation.from_config(config)
+    vstate = None
+    auditor = None
+    telemetry["valuation_last_audit"] = None
+    if valuation_cfg is not None:
+        vstate = ValuationState(n_clients, store=store)
+        if resumed_valuation is not None:
+            vstate.load(resumed_valuation)
+        elif start_round > 0:
+            logger.warning(
+                "checkpoint carries no valuation vector (written before "
+                "the feature or with client_valuation='off'); valuation "
+                "restarts from zero"
+            )
+        if valuation_cfg.audit_every > 0:
+            auditor = ValuationAuditor(
+                config, valuation_cfg, algorithm, model.apply, optimizer,
+                preprocess,
+                make_eval_fn(model.apply, preprocess=eval_preprocess),
+                client_data, eval_batches, n_clients,
+            )
     # Predictive cost model (telemetry/costmodel.py): parse the reference
     # trace ONCE at startup (pure host-side gzip read); the roofline
     # prediction attaches to the run's LAST metrics record (schema v6)
@@ -1069,7 +1115,8 @@ def run_simulation(
     telemetry["costmodel"] = None
 
     def emit_record(round_idx, metrics, fetched_loss, fetched_tel, ctx,
-                    tel_rec_fn, phase_round=None, stream_rec=None):
+                    tel_rec_fn, phase_round=None, stream_rec=None,
+                    audit_fn=None):
         """Build + persist ONE round's metrics record from already-fetched
         host values: post_round hook, record assembly, quorum/cohort
         telemetry accumulation, client-stats detection, history append +
@@ -1184,6 +1231,44 @@ def run_simulation(
             telemetry["buffer_occupancy"].append(
                 int(fetched_tel["buffer_count"])
             )
+        val_rec = None
+        if vstate is not None and "valuation_scores" in fetched_tel:
+            # Streaming valuation fold (telemetry/valuation.py): the
+            # round's in-program scores, scaled by the server loss-delta
+            # (previous test loss minus this round's — post_round has
+            # NOT yet replaced prev_metrics at this point, so the delta
+            # is exactly this round's improvement), scatter-folded into
+            # the persistent per-client vector. Round 0 (no previous
+            # metric) folds a 0 delta — the vector starts moving once
+            # there is a baseline to improve on.
+            v_ids = fetched_tel.get("participants")
+            if v_ids is not None:
+                v_ids = np.asarray(v_ids)
+            loss_delta = (
+                float(prev_metrics["loss"]) - float(metrics["loss"])
+                if prev_metrics else 0.0
+            )
+            vstate.fold(
+                v_ids, np.asarray(fetched_tel["valuation_scores"]),
+                loss_delta, valuation_cfg.decay,
+            )
+            audit_rec = audit_fn(v_ids) if audit_fn is not None else None
+            if audit_rec is not None:
+                telemetry["valuation_last_audit"] = {
+                    "round": round_idx, **audit_rec,
+                }
+                logger.info(
+                    "round %d valuation audit: spearman=%s pearson=%s "
+                    "(%d permutations, %d subset evals, converged=%s, "
+                    "memo_hit_rate=%s, %.1fs)",
+                    round_idx, audit_rec["spearman"],
+                    audit_rec["pearson"], audit_rec["permutations"],
+                    audit_rec["subset_evals"], audit_rec["converged"],
+                    audit_rec["memo_hit_rate"], audit_rec["seconds"],
+                )
+            val_rec = valuation_record(
+                vstate, v_ids, loss_delta, audit=audit_rec,
+            )
         cm_rec = None
         if cost_ledger is not None and round_idx == config.round - 1:
             # The run's measured per-round wall, averaged over the steady
@@ -1208,10 +1293,11 @@ def run_simulation(
         if (
             tel_rec is not None or cs_rec is not None
             or async_rec is not None or stream_rec is not None
-            or cm_rec is not None
+            or cm_rec is not None or val_rec is not None
         ):
             record = build_round_record(
-                record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec
+                record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec,
+                val_rec,
             )
         history.append(record)
         if metrics_path:
@@ -1240,13 +1326,22 @@ def run_simulation(
             k for k in ("client_stats", "quant_mse", "vote_agreement")
             if k in p["aux"]
         ] if cs_fetch else []
+        # Valuation scores ride EVERY round's single metric fetch (the
+        # host fold needs each round's loss-delta pairing) — N floats,
+        # not on the client_stats_every cadence.
+        val_keys = (
+            ["valuation_scores"]
+            if vstate is not None and "valuation_scores" in p["aux"]
+            else []
+        )
         async_keys = [k for k in _ASYNC_AUX_KEYS if k in p["aux"]]
         with phase_timer.phase(p["round_idx"], "host_sync"), _oom_hint(
                 config, p["new_global"], n_clients,
                 site="deferred metric fetch"):
             fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
                 (p["metrics_dev"], p["mean_loss_dev"],
-                 {k: p["aux"][k] for k in tel_keys + cs_keys + async_keys})
+                 {k: p["aux"][k]
+                  for k in tel_keys + cs_keys + val_keys + async_keys})
             )
         metrics = {k: float(v) for k, v in fetched_metrics.items()}
         ctx = RoundContext(
@@ -1300,9 +1395,25 @@ def run_simulation(
                 tel_rec["peak_hbm_bytes"] = peak
             return tel_rec
 
+        def audit_fn(v_ids):
+            """Sparse-cadence GTG cross-validation (telemetry/valuation
+            .py): replays THIS round's cohort from its round key against
+            the pre-round global params — a pure read, the recorded
+            aggregate came from the normal program."""
+            if auditor is None or not auditor.due(p["round_idx"]):
+                return None
+            with annotate("valuation_audit"):
+                return auditor.run(
+                    p["round_idx"], p["round_key"], p["prev_global"],
+                    v_ids, vstate.values,
+                    lr_scale=float(
+                        lr_factors(config, p["round_idx"], 1)[0]
+                    ),
+                )
+
         emit_record(
             p["round_idx"], metrics, fetched_loss, fetched_tel, ctx,
-            tel_rec_fn, stream_rec=p.get("stream"),
+            tel_rec_fn, stream_rec=p.get("stream"), audit_fn=audit_fn,
         )
 
         if (
@@ -1317,6 +1428,7 @@ def run_simulation(
                 _algo_checkpoint_state(
                     algorithm, metrics, p["server_state"],
                     p.get("async_state"),
+                    vstate.values if vstate is not None else None,
                 ),
                 p["key"],
             )
@@ -1361,6 +1473,14 @@ def run_simulation(
             name for name in ("client_stats", "quant_mse", "vote_agreement")
             if name in aux_k
         ] if fetch_rounds else []
+        # Valuation scores: stacked [K, N] — every round's row feeds its
+        # own loss-delta fold (no cadence; the vector must not skip
+        # rounds).
+        val_keys = (
+            ["valuation_scores"]
+            if vstate is not None and "valuation_scores" in aux_k
+            else []
+        )
         async_keys = [name for name in _ASYNC_AUX_KEYS if name in aux_k]
         with phase_timer.phase(last, "host_sync"), _oom_hint(
                 config, d["new_global"], n_clients,
@@ -1368,7 +1488,7 @@ def run_simulation(
             fetched_metrics, fetched_loss, fetched_tel = jax.device_get(
                 (d["metrics"], d["mean_loss"],
                  {name: aux_k[name]
-                  for name in tel_keys + cs_keys + async_keys})
+                  for name in tel_keys + cs_keys + val_keys + async_keys})
             )
 
         def tel_rec_fn():
@@ -1408,7 +1528,7 @@ def run_simulation(
             metrics = {
                 name: float(v[i]) for name, v in fetched_metrics.items()
             }
-            row_keys = tel_keys + async_keys + (
+            row_keys = tel_keys + async_keys + val_keys + (
                 cs_keys if round_idx in fetch_rounds else []
             )
             tel_row = {name: fetched_tel[name][i] for name in row_keys}
@@ -1448,6 +1568,7 @@ def run_simulation(
                 _algo_checkpoint_state(
                     algorithm, prev_metrics, d["server_state"],
                     d.get("async_state"),
+                    vstate.values if vstate is not None else None,
                 ),
                 d["key"],
             )
@@ -1834,6 +1955,7 @@ def run_simulation(
                         recompile.attribute(round_idx)
                     entry = {
                         "round_idx": round_idx,
+                        "round_key": round_key,
                         "new_global": new_global,
                         "prev_global": global_params,
                         # Sampled streamed: the (post-writeback) host
@@ -1906,7 +2028,8 @@ def run_simulation(
                     forced_path, completed_round, global_params,
                     store.state if stream_sampled else client_state,
                     _algo_checkpoint_state(
-                        algorithm, prev_metrics, server_state, async_state
+                        algorithm, prev_metrics, server_state, async_state,
+                        vstate.values if vstate is not None else None,
                     ),
                     key,
                 )
@@ -2007,6 +2130,24 @@ def run_simulation(
         # cost_model_trace is unset, the trace was empty, or the run was
         # preempted before its last round.
         "costmodel": telemetry["costmodel"],
+        # Always-on client valuation (telemetry/valuation.py): the
+        # top/bottom client tables + the latest audit (bench.py's
+        # ``valuation`` leg reads these); ``valuation_state`` is the
+        # live ValuationState for library callers/scripts that need the
+        # full vector (like ``algorithm``, an object — not JSON). Both
+        # None when client_valuation='off'.
+        "client_valuation": config.client_valuation,
+        "valuation": (
+            vstate.summary(telemetry["valuation_last_audit"])
+            if vstate is not None else None
+        ),
+        "valuation_state": vstate,
+        # GTG cross-round memo reuse (config.gtg_cross_round_memo,
+        # ROADMAP item 4b): the last walk's cross-round subset-utility
+        # hit rate — None when the memo is off or no walk ran.
+        "gtg_memo_hit_rate": getattr(
+            algorithm, "gtg_memo_hit_rate", None
+        ),
         "preempted_at": preempted_at,
     }
 

@@ -77,6 +77,15 @@ from distributed_learning_simulator_tpu.telemetry.topologies import (
     Topology,
     get_topology,
 )
+from distributed_learning_simulator_tpu.telemetry.valuation import (
+    ClientValuation,
+    ValuationAuditor,
+    ValuationState,
+    cohort_crc,
+    pearson_corr,
+    spearman_corr,
+    valuation_record,
+)
 
 __all__ = [
     "CLIENT_STATS_LEVELS",
@@ -88,12 +97,16 @@ __all__ = [
     "TELEMETRY_LEVELS",
     "TOPOLOGIES",
     "ClientStats",
+    "ClientValuation",
     "NullPhaseTimer",
     "PhaseTimer",
     "RecompileMonitor",
     "Topology",
+    "ValuationAuditor",
+    "ValuationState",
     "attribution_crosscheck",
     "client_stats_record",
+    "cohort_crc",
     "costmodel_record",
     "detect_and_record",
     "detect_anomalies",
@@ -104,5 +117,8 @@ __all__ = [
     "log_round_compiles",
     "make_phase_timer",
     "peak_hbm_bytes",
+    "pearson_corr",
     "predict_round",
+    "spearman_corr",
+    "valuation_record",
 ]

@@ -1,0 +1,536 @@
+"""Always-on client valuation: streaming Shapley-proxy telemetry.
+
+The source paper's headline beyond-FedAvg capability is per-client
+contribution scoring, but converged GTG at N=1000 costs 156-343 s/round
+against the 2.3 s flagship round — ~100x too slow to run in-line, so
+valuation was an offline batch job (ROADMAP item 4). This module turns it
+into a per-round production signal with a measured fidelity bound:
+
+* **Streaming estimator** — ``client_valuation='on'`` (off default: the
+  exact pre-feature program, byte-identical v6 records — the PR 4/6/7
+  trace-time off-gate discipline) adds ONE tiny per-cohort score vector
+  to the jitted round, derived from the PR 4 client-stats matrix the
+  round already computes: ``score_i = cos(update_i, aggregate) *
+  ||update_i||``, normalized to unit L1 over the cohort. Host-side, each
+  round's scores are scaled by the server loss-delta (previous test loss
+  minus this round's — positive when the round helped) and folded into a
+  per-client valuation vector with exponential decay
+  (``valuation_decay``): clients whose updates consistently align with
+  improving aggregates accumulate value; anti-aligned or inert clients
+  decay toward zero. Cost: O(cohort) scalars per round on device and
+  host — it rides the round at marginal cost, like scheduling.
+* **Population scale** — the vector is a host numpy ``[N]`` array
+  updated by cohort scatter; under ``client_residency='streamed'`` it
+  attaches to the :class:`~..data.residency.HostShardStore` (the
+  source of truth between dispatches), so a 1e6-client population costs
+  4 MB of host RAM and O(cohort) work per round. Checkpointed in
+  ``algo_state`` and restored on resume in both residency modes.
+* **Fidelity audit** — on the sparse ``valuation_audit_every`` cadence,
+  :class:`ValuationAuditor` re-materializes the CURRENT cohort's exact
+  uploads (replaying local training from the round key — the PR 2/6/7
+  round-key-chain discipline, algorithms/fedavg.py
+  ``make_valuation_audit_fn``) and runs a truncated GTG walk over them
+  (``algorithms/shapley.gtg_walk`` — the same estimator, cumsum prefix
+  aggregation and all, budgeted by ``valuation_audit_permutations``),
+  with a cross-round subset-utility memo keyed by the cohort hash
+  (ROADMAP item 4b). The Spearman/Pearson correlation between the
+  streaming vector and the audit SVs lands in the schema-v7
+  ``valuation`` record sub-object — every run carries both the cheap
+  always-on signal and a measured bound on how well it tracks exact
+  Shapley. bench.py's ``valuation`` leg measures both the overhead and
+  the small-N fidelity; scripts/compare_bench.py gates the correlation
+  absolutely (``--valuation-corr-threshold``).
+
+Semantics, cadence, and tuning: docs/OBSERVABILITY.md § Client
+valuation; the incentive-side read of fault injection:
+docs/ROBUSTNESS.md.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.telemetry.client_stats import (
+    _IDX,
+    PER_CLIENT_CAP,
+)
+
+#: Clients listed in the per-round record's top/bottom valuation tables.
+TOP_K = 8
+
+
+@dataclass(frozen=True)
+class ClientValuation:
+    """Static (trace-time) valuation configuration. ``from_config``
+    returns None when ``client_valuation='off'`` — every call site gates
+    on that, so off-mode runs compile the exact pre-feature program."""
+
+    decay: float = 0.9
+    audit_every: int = 0
+    audit_permutations: int = 16
+
+    @classmethod
+    def from_config(cls, config) -> "ClientValuation | None":
+        level = (
+            getattr(config, "client_valuation", "off") or "off"
+        ).lower()
+        if level == "off":
+            return None
+        if level != "on":
+            raise ValueError(
+                f"unknown client_valuation {level!r}; known: off, on"
+            )
+        return cls(
+            decay=float(getattr(config, "valuation_decay", 0.9)),
+            audit_every=int(getattr(config, "valuation_audit_every", 0)),
+            audit_permutations=int(
+                getattr(config, "valuation_audit_permutations", 16)
+            ),
+        )
+
+    def audit_round(self, round_idx: int) -> bool:
+        """Whether this round runs the GTG audit walk. Round 0 never
+        audits: the valuation vector is all-zero before its first fold,
+        so a correlation against it is undefined."""
+        return (
+            self.audit_every > 0
+            and round_idx > 0
+            and round_idx % self.audit_every == 0
+        )
+
+    # ---- jit side ----------------------------------------------------------
+    def scores(self, stats_matrix) -> jnp.ndarray:
+        """Per-cohort streaming contribution scores from the ``[N, S]``
+        client-stats matrix (telemetry/client_stats.py STAT_FIELDS):
+        ``cos(update, aggregate) * ||update||`` normalized to unit L1.
+        Non-finite entries (a corrupt upload's NaN norm) contribute 0 —
+        a poisoned client must not poison the whole score vector."""
+        cos = stats_matrix[:, _IDX["agg_cosine"]]
+        norm = stats_matrix[:, _IDX["update_norm"]]
+        raw = cos * norm
+        raw = jnp.where(jnp.isfinite(raw), raw, 0.0)
+        return raw / (jnp.sum(jnp.abs(raw)) + 1e-12)
+
+
+def cohort_crc(ids, n_clients: int) -> int:
+    """Cohort fingerprint keying the cross-round audit memo — the same
+    int64-CRC formula as the metrics record's ``cohort_hash``
+    (simulator.emit_record), with the full population spelled out when
+    sampling is off (``ids=None``)."""
+    arr = (
+        np.arange(n_clients, dtype=np.int64) if ids is None
+        else np.ascontiguousarray(ids, dtype=np.int64)
+    )
+    return zlib.crc32(arr.tobytes())
+
+
+# ---- correlations (jax-free; unit-testable without a backend) --------------
+
+
+def pearson_corr(a, b) -> float | None:
+    """Pearson correlation over finite pairs; None when degenerate
+    (fewer than 2 finite pairs, or either side has zero variance)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    ok = np.isfinite(a) & np.isfinite(b)
+    if ok.sum() < 2:
+        return None
+    a, b = a[ok], b[ok]
+    if np.ptp(a) == 0.0 or np.ptp(b) == 0.0:
+        return None
+    return float(np.corrcoef(a, b)[0, 1])
+
+
+def _average_ranks(x: np.ndarray) -> np.ndarray:
+    """Average-rank transform (ties share the mean of their positions) —
+    un-updated clients all sitting at exactly 0 must not get an
+    arbitrary tie-broken ordering."""
+    order = np.argsort(x, kind="mergesort")
+    ranks = np.empty(x.shape[0], dtype=np.float64)
+    sx = x[order]
+    i = 0
+    while i < x.shape[0]:
+        j = i
+        while j + 1 < x.shape[0] and sx[j + 1] == sx[i]:
+            j += 1
+        ranks[order[i : j + 1]] = 0.5 * (i + j)
+        i = j + 1
+    return ranks
+
+
+def spearman_corr(a, b) -> float | None:
+    """Spearman rank correlation (average ranks on ties) over finite
+    pairs; None when degenerate. The fidelity gate's metric: valuation
+    is a RANKING signal (who contributed more), so rank correlation is
+    the honest bound — scale disagreement between loss-delta units and
+    accuracy-utility SVs is irrelevant."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    ok = np.isfinite(a) & np.isfinite(b)
+    if ok.sum() < 2:
+        return None
+    ra, rb = _average_ranks(a[ok]), _average_ranks(b[ok])
+    if np.ptp(ra) == 0.0 or np.ptp(rb) == 0.0:
+        return None
+    return float(np.corrcoef(ra, rb)[0, 1])
+
+
+# ---- host-side state --------------------------------------------------------
+
+
+class ValuationState:
+    """The persistent per-client valuation vector (host numpy ``[N]``).
+
+    Under ``client_residency='streamed'`` the vector attaches to the
+    :class:`HostShardStore` (``store.valuation``) so the store remains
+    the one source of truth the streamed checkpoints and scripts read;
+    resident runs own the array directly. Either way updates are an
+    O(cohort) scatter."""
+
+    def __init__(self, n_clients: int, store=None):
+        self._store = store
+        if store is not None:
+            if getattr(store, "valuation", None) is None:
+                store.attach_valuation(
+                    np.zeros(n_clients, dtype=np.float64)
+                )
+            if store.valuation.shape[0] != n_clients:
+                raise ValueError(
+                    "store valuation length "
+                    f"{store.valuation.shape[0]} != n_clients {n_clients}"
+                )
+        else:
+            self._values = np.zeros(n_clients, dtype=np.float64)
+
+    @property
+    def values(self) -> np.ndarray:
+        return (
+            self._store.valuation if self._store is not None
+            else self._values
+        )
+
+    def load(self, values) -> None:
+        """Restore from a checkpoint's saved vector (resume path)."""
+        values = np.asarray(values, dtype=np.float64)
+        if values.shape != self.values.shape:
+            raise ValueError(
+                "checkpoint valuation vector has "
+                f"{values.shape[0]} clients, this run has "
+                f"{self.values.shape[0]}; resume with the configuration "
+                "the checkpoint was written with"
+            )
+        if self._store is not None:
+            self._store.attach_valuation(values)
+        else:
+            self._values = values
+
+    def fold(self, ids, scores, loss_delta: float, decay: float) -> None:
+        """One round's exponential-decay fold: participants' entries move
+        toward ``loss_delta * score``; non-participants keep their value
+        (their evidence didn't change). ``ids=None`` = whole population.
+        """
+        contrib = loss_delta * np.asarray(scores, dtype=np.float64)
+        contrib = np.where(np.isfinite(contrib), contrib, 0.0)
+        v = self.values
+        if ids is None:
+            v *= decay
+            v += (1.0 - decay) * contrib
+        else:
+            idx = np.asarray(ids, dtype=np.int64)
+            v[idx] = decay * v[idx] + (1.0 - decay) * contrib
+
+    def top(self, k: int = TOP_K) -> list[tuple[int, float]]:
+        v = self.values
+        order = np.argsort(-v, kind="mergesort")[: min(k, v.shape[0])]
+        return [(int(i), float(v[i])) for i in order]
+
+    def bottom(self, k: int = TOP_K) -> list[tuple[int, float]]:
+        v = self.values
+        order = np.argsort(v, kind="mergesort")[: min(k, v.shape[0])]
+        return [(int(i), float(v[i])) for i in order]
+
+    def summary(self, last_audit: dict | None = None) -> dict:
+        """The result-dict face of the vector (bench.py's valuation leg
+        and library callers): top/bottom tables + the latest audit."""
+        return {
+            "top_clients": [
+                {"id": i, "value": round(v, 8)} for i, v in self.top()
+            ],
+            "bottom_clients": [
+                {"id": i, "value": round(v, 8)} for i, v in self.bottom()
+            ],
+            "last_audit": last_audit,
+        }
+
+
+def valuation_record(state: ValuationState, ids, loss_delta: float,
+                     audit: dict | None = None,
+                     per_client_cap: int = PER_CLIENT_CAP) -> dict:
+    """Build the ``valuation`` sub-object of a schema-v7 metrics record
+    (utils/reporting.build_round_record attaches it): top-k/bottom-k
+    client tables always; raw per-client values only for populations up
+    to ``per_client_cap`` (the client-stats rule — large-N runs must not
+    bloat metrics.jsonl); the audit result on audit rounds."""
+    v = state.values
+    n = int(v.shape[0])
+    record: dict = {
+        "n_clients": n,
+        "updated": n if ids is None else int(np.asarray(ids).shape[0]),
+        "loss_delta": round(float(loss_delta), 6),
+        "top_clients": [
+            {"id": i, "value": round(val, 8)} for i, val in state.top()
+        ],
+        "bottom_clients": [
+            {"id": i, "value": round(val, 8)} for i, val in state.bottom()
+        ],
+    }
+    if n <= per_client_cap:
+        record["per_client"] = {
+            "client_ids": list(range(n)),
+            "value": [round(float(x), 8) for x in v],
+        }
+    if audit is not None:
+        record["audit"] = audit
+    return record
+
+
+def grade_client_labels(y, num_classes: int, seed: int = 0) -> np.ndarray:
+    """Graded label corruption for the fidelity differential config.
+
+    Client ``i`` of ``N`` gets fraction ``i / (N - 1)`` of its packed
+    labels replaced with uniform-random classes: a monotonic
+    data-quality gradient from clean (client 0) to noise (client N-1),
+    so BOTH a faithful contribution estimator and exact Shapley should
+    rank clients near-monotonically — the engineered ground truth the
+    bench fidelity leg and tests/test_valuation.py correlate against.
+    Shared by both so they measure the same workload. ``y`` is the
+    packed ``[N, S]`` label array (data/partition.ClientData.y).
+    """
+    y = np.array(y, copy=True)
+    n = y.shape[0]
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        frac = i / max(n - 1, 1)
+        k = int(round(frac * y.shape[1]))
+        if k == 0:
+            continue
+        slots = rng.choice(y.shape[1], size=k, replace=False)
+        y[i, slots] = rng.integers(0, num_classes, size=k)
+    return y
+
+
+class ValuationAuditor:
+    """Sparse-cadence GTG cross-validation of the streaming estimator.
+
+    On ``valuation_audit_every`` rounds the auditor (1) re-materializes
+    the round's exact cohort uploads by replaying local training from
+    the round key (``FedAvg.make_valuation_audit_fn`` — faults/async/
+    persistent state are refused by config.validate(), which is what
+    keeps the replay exact), (2) runs a budgeted GTG permutation walk
+    over the stack (``algorithms/shapley.gtg_walk`` — the identical
+    estimator the offline GTG server runs, down to the cumsum prefix
+    walker), optionally seeding its subset-utility memo from the last
+    audit of the same cohort (``cohort_crc``; only when
+    ``config.gtg_cross_round_memo`` opts in — see the staleness note at
+    the seeding site), and (3) reports Spearman/Pearson correlation
+    between the current streaming valuation vector (restricted to the
+    cohort) and the audits' cumulative per-client SV estimate. The
+    audit NEVER feeds back into training — it is a pure read; the
+    round's aggregate came from the normal program.
+
+    Cost: one extra cohort training pass plus
+    ``min(valuation_audit_permutations, N)`` permutation walks — the
+    "full walks on a sparse cadence" half of ROADMAP item 4's plan, with
+    the streaming vector as the always-on other half.
+    """
+
+    def __init__(self, config, cv: ClientValuation, algorithm, apply_fn,
+                 optimizer, preprocess, eval_fn, client_data,
+                 eval_batches, n_clients: int):
+        self._config = config
+        self._cv = cv
+        self._stack_jit = jax.jit(
+            algorithm.make_valuation_audit_fn(
+                apply_fn, optimizer, preprocess=preprocess
+            )
+        )
+        self._eval_fn = eval_fn
+        # Host copies of the packed shards: cohort gathers for the replay
+        # work identically under resident and streamed residency (the
+        # arrays are the same ones the store/device copies came from).
+        self._x = np.asarray(client_data.x)
+        self._y = np.asarray(client_data.y)
+        self._mask = np.asarray(client_data.mask)
+        self._sizes = np.asarray(client_data.sizes)
+        self._eval_batches = eval_batches
+        self._n = n_clients
+        self._evaluator = None
+        self._capped_batches = None
+        # Cross-round memo: only the LATEST walk's utilities are kept
+        # (the reuse premise is consecutive same-cohort walks; under
+        # sampling the key changes every audit and an unbounded
+        # per-cohort dict would just leak). {cohort crc -> utilities}.
+        self._memo_store: dict[int, dict] = {}
+        # Running per-CLIENT mean of audit SVs, keyed by TRUE client id:
+        # a single round's GTG SVs are Monte-Carlo + accuracy-
+        # quantization noisy (marginals live in units of 1/n_test); the
+        # streaming vector is multi-round evidence, so the honest
+        # fidelity reference is the audits' cumulative estimate — the
+        # same round-averaging multi-round Shapley does. Population-
+        # indexed (not per-cohort-keyed) so sampled cohorts accumulate
+        # too, in O(N) memory.
+        self._sv_sum = np.zeros(n_clients, dtype=np.float64)
+        self._sv_count = np.zeros(n_clients, dtype=np.int64)
+        self._n_audits = 0
+        # Decoupled from every training stream: the audit's permutation
+        # draws must not perturb (or be perturbed by) the run's RNG.
+        self._rng = np.random.default_rng(
+            getattr(config, "seed", 0) + 29
+        )
+
+    def due(self, round_idx: int) -> bool:
+        return self._cv.audit_round(round_idx)
+
+    def _get_evaluator(self):
+        if self._evaluator is None:
+            from distributed_learning_simulator_tpu.algorithms.shapley import (
+                _EVAL_CHUNK,
+                _SubsetEvaluator,
+                cap_eval_batches,
+            )
+
+            # f32 stack reads: the audit is the fidelity REFERENCE, so it
+            # takes the exact-parity dtype (an explicit
+            # shapley_eval_dtype='bfloat16' wins, for large-N audits
+            # where the stack-read traffic matters).
+            dtype = getattr(self._config, "shapley_eval_dtype", "auto")
+            self._evaluator = _SubsetEvaluator(
+                self._eval_fn,
+                chunk=getattr(
+                    self._config, "shapley_eval_chunk", _EVAL_CHUNK
+                ),
+                eval_dtype="float32" if dtype == "auto" else dtype,
+            )
+            self._capped_batches = cap_eval_batches(
+                self._eval_batches,
+                getattr(self._config, "shapley_eval_samples", None),
+            )
+        return self._evaluator
+
+    def run(self, round_idx: int, round_key, prev_global, ids,
+            values: np.ndarray, lr_scale: float = 1.0) -> dict:
+        """One audit: returns the ``audit`` sub-object (correlations,
+        walk budget spent, memo reuse, wall seconds)."""
+        from distributed_learning_simulator_tpu.algorithms.fedavg import (
+            round_key_splits,
+        )
+        from distributed_learning_simulator_tpu.algorithms.shapley import (
+            SubsetMemo,
+            eval_subsets,
+            gtg_walk,
+        )
+
+        t0 = time.perf_counter()
+        idx = (
+            np.arange(self._n, dtype=np.int64) if ids is None
+            else np.asarray(ids, dtype=np.int64)
+        )
+        n = int(idx.shape[0])
+        # The round's split chain (audits refuse failure models, so the
+        # 4-way split): train_key fans out to the exact per-client keys
+        # the live round used; payload_key replays fed_quant's upload
+        # quantization.
+        _, train_key, payload_key, _, _ = round_key_splits(
+            round_key, with_faults=False
+        )
+        client_keys = jax.random.split(train_key, n)
+        stack = self._stack_jit(
+            prev_global,
+            jnp.asarray(self._x[idx]),
+            jnp.asarray(self._y[idx]),
+            jnp.asarray(self._mask[idx]),
+            client_keys,
+            payload_key,
+            jnp.float32(lr_scale),
+        )
+        evaluator = self._get_evaluator()
+        stack = evaluator.prepare_stack(stack)
+        sizes_k = jnp.asarray(self._sizes[idx])
+        key = cohort_crc(idx, self._n)
+        # Cross-round memo reuse follows the same opt-in as the GTG
+        # server (config.gtg_cross_round_memo, default off): reused
+        # utilities describe an EARLIER audit's params, and at a sparse
+        # audit cadence the model moves a lot between audits — measured:
+        # a 0.99 hit rate dragged the per-round audit spearman from 0.88
+        # to 0.43 on the graded-quality differential. Off keeps every
+        # audit's utilities fresh (the honest default); on trades
+        # fidelity for walk cost, with memo_hit_rate + the correlation
+        # itself as the self-policing record.
+        cross_round = bool(
+            getattr(self._config, "gtg_cross_round_memo", False)
+        )
+        seed = self._memo_store.get(key) if cross_round else None
+        if seed:
+            # Same rule as GTGShapley's cross-round memo: the empty and
+            # grand coalitions anchor the walk — always fresh.
+            seed = {k: v for k, v in seed.items() if 0 < len(k) < n}
+        memo = SubsetMemo(seed)
+        grand = frozenset(range(n))
+        eval_subsets(
+            evaluator, stack, sizes_k, prev_global,
+            self._capped_batches, n, memo, [frozenset(), grand],
+        )
+        cfg = self._config
+        sv_arr, n_perms, converged = gtg_walk(
+            evaluator, stack, sizes_k, prev_global, self._capped_batches,
+            n, self._rng,
+            eps=getattr(cfg, "gtg_eps", 1e-3),
+            cap=self._cv.audit_permutations,
+            last_k=getattr(cfg, "gtg_last_k", 10),
+            converge_criteria=getattr(cfg, "gtg_converge_criteria", 0.05),
+            # Self-consistent truncation reference: the grand-coalition
+            # utility from the SAME (possibly subsampled) estimator, the
+            # rule GTGShapley applies whenever estimators could disagree.
+            trunc_ref=memo[grand],
+            prefix_mode=getattr(cfg, "gtg_prefix_mode", "cumsum"),
+            memo=memo,
+            starts_per_iteration=min(self._cv.audit_permutations, n),
+        )
+        if cross_round:
+            # Latest-walk-only retention: consecutive audits of the same
+            # cohort reuse it; a changed cohort simply misses.
+            self._memo_store = {key: dict(memo)}
+        self._sv_sum[idx] += sv_arr
+        self._sv_count[idx] += 1
+        self._n_audits += 1
+        sv_mean = self._sv_sum[idx] / np.maximum(self._sv_count[idx], 1)
+        vals_cohort = np.asarray(values, dtype=np.float64)[idx]
+        # The reported correlations compare the streaming vector against
+        # the CUMULATIVE audit SV estimate (see _sv_accum) — the
+        # per-round walk's own SVs additionally land as spearman_round
+        # so single-audit noise stays inspectable.
+        sp = spearman_corr(vals_cohort, sv_mean)
+        pe = pearson_corr(vals_cohort, sv_mean)
+        sp_round = spearman_corr(vals_cohort, sv_arr)
+        hit_rate = memo.hit_rate() if cross_round else None
+        return {
+            "spearman": None if sp is None else round(sp, 4),
+            "pearson": None if pe is None else round(pe, 4),
+            "spearman_round": (
+                None if sp_round is None else round(sp_round, 4)
+            ),
+            "audits": int(self._n_audits),
+            "permutations": int(n_perms),
+            "subset_evals": int(memo.evaluated),
+            "converged": bool(converged),
+            "memo_hit_rate": (
+                None if hit_rate is None else round(hit_rate, 4)
+            ),
+            "seconds": round(time.perf_counter() - t0, 3),
+        }

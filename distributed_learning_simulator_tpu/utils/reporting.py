@@ -27,15 +27,21 @@ import json
 # parallel/streaming.py). v6 adds the ``costmodel`` sub-object (the
 # roofline cost model's per-topology round-time/cost prediction with
 # model-vs-measured error ratio; telemetry/costmodel.py — attached to
-# the run's LAST record when config.cost_model_trace is set). A record
+# the run's LAST record when config.cost_model_trace is set). v7 adds
+# the ``valuation`` sub-object (the streaming per-client contribution
+# vector's fold inputs and top/bottom tables, and — on audit rounds —
+# the truncated-GTG cross-validation correlations;
+# telemetry/valuation.py). A record
 # is stamped with the LOWEST version that describes it:
 # telemetry_level='off' keeps emitting v1 byte-for-byte,
 # client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
 # async_mode='off' keeps records at v3 or below, client_residency=
-# 'resident' keeps records at v4 or below, and cost_model_trace=None
-# keeps records at v5 or below — longitudinal tooling never sees a
+# 'resident' keeps records at v4 or below, cost_model_trace=None
+# keeps records at v5 or below, and client_valuation='off' keeps
+# records at v6 or below — longitudinal tooling never sees a
 # layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 6
+METRICS_SCHEMA_VERSION = 7
+_COSTMODEL_SCHEMA_VERSION = 6
 _STREAM_SCHEMA_VERSION = 5
 _ASYNC_SCHEMA_VERSION = 4
 _CLIENT_STATS_SCHEMA_VERSION = 3
@@ -86,7 +92,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
                        client_stats: dict | None = None,
                        async_federation: dict | None = None,
                        stream: dict | None = None,
-                       costmodel: dict | None = None) -> dict:
+                       costmodel: dict | None = None,
+                       valuation: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -103,15 +110,19 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     per-dispatch transfer stats, parallel/streaming.py) upgrades it to
     v5 under the ``"stream"`` key; a costmodel dict
     (telemetry/costmodel.costmodel_record) upgrades it to v6 under the
-    ``"costmodel"`` key.
+    ``"costmodel"`` key; a valuation dict
+    (telemetry/valuation.valuation_record) upgrades it to v7 under the
+    ``"valuation"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
-    ) and stream is None and costmodel is None:
+    ) and stream is None and costmodel is None and valuation is None:
         return base
     record = dict(base)
-    if costmodel is not None:
+    if valuation is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif costmodel is not None:
+        record["schema_version"] = _COSTMODEL_SCHEMA_VERSION
     elif stream is not None:
         record["schema_version"] = _STREAM_SCHEMA_VERSION
     elif async_federation is not None:
@@ -130,6 +141,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["stream"] = stream
     if costmodel is not None:
         record["costmodel"] = costmodel
+    if valuation is not None:
+        record["valuation"] = valuation
     return record
 
 
@@ -144,6 +157,18 @@ def config_hash(config) -> str:
     d = dataclasses.asdict(config)
     for k in _NON_PROGRAM_FIELDS:
         d.pop(k, None)
+    # Off-gated knobs drop out of the hash AT THEIR OFF VALUE: a
+    # trace-time-gated feature that is off compiles the exact pre-feature
+    # program, so pre-feature configs keep their pre-feature hash
+    # (longitudinal bench comparability survives the feature landing)
+    # while any ACTIVE setting — which does change the program or its
+    # record stream — lands every one of its knobs in the hash.
+    if (d.get("client_valuation") or "off").lower() == "off":
+        for k in ("client_valuation", "valuation_decay",
+                  "valuation_audit_every", "valuation_audit_permutations"):
+            d.pop(k, None)
+    if not d.get("gtg_cross_round_memo", False):
+        d.pop("gtg_cross_round_memo", None)
     blob = json.dumps(d, sort_keys=True, default=repr)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 

@@ -41,6 +41,11 @@ is an absolute floor, default 1.0. And the ``stream`` leg's prefetch
 ``overlap_ratio`` (fraction of host->HBM upload time hidden behind
 compute at the largest swept population, client_residency='streamed'):
 ``--stream-overlap-threshold`` is an absolute floor, default 0.5. The
+``valuation`` leg's ``audit_spearman`` (streaming client-valuation
+vector vs cumulative exact-GTG audit SVs on the graded-quality
+differential config, telemetry/valuation.py) gets
+``--valuation-corr-threshold`` as an absolute floor, default 0.8 —
+the cheap estimator must keep tracking exact Shapley. The
 ``costmodel`` leg's ``model_error_ratio`` per program (predicted /
 measured per-round ms from the roofline model, telemetry/costmodel.py)
 is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
@@ -239,6 +244,33 @@ def stream_overlap_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def valuation_corr_gate(record: dict, threshold: float) -> dict | None:
+    """In-record valuation-fidelity gate: bench.py's ``valuation`` leg
+    measures, on the small-N graded-quality differential config, the
+    Spearman correlation between the streaming client-valuation vector
+    and the cumulative truncated-GTG audit SVs
+    (telemetry/valuation.py). A correlation below ``threshold`` means
+    the cheap always-on estimator stopped tracking exact Shapley — its
+    per-round signal is no longer a trustworthy contribution ranking —
+    a regression regardless of the old record. Judged ABSOLUTELY (the
+    PR 4/5/8 precedent: the correlation sits near a fixed operating
+    point ~0.85-0.9, where a relative gate would flap). None when the
+    leg is absent or the floor holds."""
+    corr = get_path(record, "valuation.audit_spearman")
+    if corr is None or corr >= threshold:
+        return None
+    return {
+        "metric": "valuation.audit_spearman",
+        "description": (
+            "Spearman correlation of the streaming client-valuation "
+            "vector vs cumulative exact GTG audit SVs on the "
+            "graded-quality differential (estimator fidelity floor)"
+        ),
+        "old": threshold, "new": corr,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def model_drift_gate(record: dict, threshold: float) -> list[dict]:
     """In-record cost-model drift gate: bench.py's ``costmodel`` leg
     records, per proxied program, the roofline model's predicted-vs-
@@ -311,6 +343,13 @@ def main(argv: list[str] | None = None) -> int:
                          "record's stream leg at its largest population "
                          "(default 0.5 — at least half the host->HBM "
                          "upload time must hide behind compute)")
+    ap.add_argument("--valuation-corr-threshold", type=float, default=0.8,
+                    help="min tolerated streaming-valuation vs GTG-audit "
+                         "Spearman correlation in the NEW record's "
+                         "valuation leg (default 0.8 — the estimator "
+                         "must keep tracking exact Shapley on the "
+                         "differential config; measured operating point "
+                         "~0.85-0.9)")
     ap.add_argument("--model-drift-threshold", type=float, default=0.35,
                     help="max tolerated |model_error_ratio - 1| in the NEW "
                          "record's costmodel leg, per program (default "
@@ -343,6 +382,7 @@ def main(argv: list[str] | None = None) -> int:
         batch_amortization_gate(new, args.batch_amortization_threshold),
         async_speedup_gate(new, args.async_speedup_threshold),
         stream_overlap_gate(new, args.stream_overlap_threshold),
+        valuation_corr_gate(new, args.valuation_corr_threshold),
     ):
         if gate is not None:
             result["regressions"].append(gate)

@@ -19,7 +19,16 @@ twice.)
 
 The last line is ONE JSON record tracking the converged-GTG round cost —
 the wall-clock of the final non-round-truncated round (round 0 carries the
-XLA compile, so prefer rounds >= 2 and read the steady-state value).
+XLA compile, so prefer rounds >= 2 and read the steady-state value) —
+plus, since ISSUE 9, the other side of the 100x gap in the same
+artifact: the streaming valuation estimator's per-round cost
+(``estimator_round_seconds``, a fed run of the same workload with the
+always-on signal; ``estimator_gap_ratio`` = walk/estimator), its
+fidelity against the run's own exact SVs
+(``valuation_spearman``/``valuation_pearson``), and the cross-round
+memo reuse rate (``gtg_memo_hit_rate``; the run sets
+``gtg_cross_round_memo=True``). ``GTG_SCALE_ESTIMATOR_ROUNDS=0`` skips
+the estimator-cost run.
 """
 
 from __future__ import annotations
@@ -51,6 +60,18 @@ def main():
         shapley_eval_samples=eval_samples, shapley_eval_chunk=eval_chunk,
         gtg_max_permutations=max_perms or None,
         shapley_eval_dtype=eval_dtype, gtg_prefix_mode=prefix_mode,
+        # Streaming valuation rides the same run (ISSUE 9): its per-round
+        # cost is measured against these GTG rounds below, and its final
+        # vector correlates against the run's own exact per-round SVs —
+        # the 100x-gap trajectory (walk seconds vs estimator seconds vs
+        # fidelity) tracked in ONE artifact.
+        client_stats="on", client_valuation="on",
+        # Cross-round memo (ROADMAP item 4b): measure the cross-round
+        # utility REUSE rate at scale. Under the default cumsum prefix
+        # mode hits do not avoid device work (the walker streams every
+        # position for its carries — shapley.SubsetMemo); pass
+        # prefix_mode=masked to measure the realized call savings.
+        gtg_cross_round_memo=True,
         log_level="INFO",
     )
     t0 = time.perf_counter()
@@ -83,12 +104,73 @@ def main():
         gtg_round_record,
     )
 
+    # Streaming-estimator cross-check (ISSUE 9): the run carried the
+    # always-on valuation vector alongside the exact walks, so the 100x
+    # gap's two sides land in ONE artifact — the walk's wall-clock above,
+    # the estimator's per-round cost below, and the fidelity correlation
+    # between the final streaming vector and the run's own mean exact
+    # SVs (rounds whose walk actually ran; truncated rounds carry none).
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.telemetry.valuation import (
+        pearson_corr,
+        spearman_corr,
+    )
+
+    n = 1000
+    sv_rounds = [
+        np.asarray([sv[i] for i in range(n)])
+        for r, sv in sorted(result["algorithm"].shapley_values.items())
+        if any(sv.values())
+    ]
+    corr_sp = corr_pe = None
+    if sv_rounds:
+        sv_mean = np.mean(np.stack(sv_rounds), axis=0)
+        values = result["valuation_state"].values
+        corr_sp = spearman_corr(values, sv_mean)
+        corr_pe = pearson_corr(values, sv_mean)
+
+    # The estimator's own per-round cost: the SAME workload as a plain
+    # fed run with the streaming valuation on — the round the always-on
+    # signal actually rides in production. GTG_SCALE_ESTIMATOR_ROUNDS=0
+    # skips (e.g. when only re-measuring the walk).
+    import dataclasses
+
+    est_rounds = int(os.environ.get("GTG_SCALE_ESTIMATOR_ROUNDS", "3"))
+    est_round_s = None
+    if est_rounds > 0:
+        fed_config = dataclasses.replace(
+            config, distributed_algorithm="fed", round=est_rounds + 1,
+            gtg_cross_round_memo=False, log_level="WARNING",
+        )
+        fed_result = run_simulation(fed_config, setup_logging=False)
+        steady = [
+            h["round_seconds"] for h in fed_result["history"][1:]
+        ]
+        if steady:
+            est_round_s = sorted(steady)[len(steady) // 2]
+
     rec = gtg_round_record(
         result["history"],
-        clients=1000, prefix_mode=prefix_mode, eval_samples=eval_samples,
+        clients=n, prefix_mode=prefix_mode, eval_samples=eval_samples,
         eval_chunk=eval_chunk, eval_dtype=eval_dtype,
         peak_hbm_gib=round(peak / 2**30, 2) if peak else None,
+        # Cross-round memo reuse at scale (ROADMAP item 4b).
+        gtg_memo_hit_rate=result["gtg_memo_hit_rate"],
+        # Estimator-vs-GTG fidelity + the estimator's round cost: the
+        # gap ratio is the ~100x the streaming signal exists to bridge.
+        valuation_spearman=(
+            None if corr_sp is None else round(corr_sp, 4)
+        ),
+        valuation_pearson=(
+            None if corr_pe is None else round(corr_pe, 4)
+        ),
+        estimator_round_seconds=(
+            None if est_round_s is None else round(est_round_s, 3)
+        ),
     )
+    if rec is not None and est_round_s:
+        rec["estimator_gap_ratio"] = round(rec["value"] / est_round_s, 1)
     if rec is not None:
         print(json.dumps(rec))
 

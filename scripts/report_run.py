@@ -31,9 +31,13 @@ model's predicted round time, bottleneck, and $/run across the
 topology table, with this run's measured round as the anchor row;
 telemetry/costmodel.py). ``--trace`` computes the same section LIVE
 from the trace's categorized ledger when the records don't carry one
-(``--cost-rounds`` sets the $/run horizon). The only heavy import
-(jax, via utils.tracing) is deferred behind ``--trace``, so
-metrics-only reporting is instant.
+(``--cost-rounds`` sets the $/run horizon), and v7 (``valuation``
+sub-object — rendered as the client-valuation section: latest
+top-k/bottom-k client tables, the loss-delta curve, the
+flagged-client overlay against the v3 client-health section, and the
+latest GTG audit-correlation line; telemetry/valuation.py). The only
+heavy import (jax, via utils.tracing) is deferred behind ``--trace``,
+so metrics-only reporting is instant.
 """
 
 from __future__ import annotations
@@ -132,6 +136,62 @@ def summarize_client_health(records: list[dict]) -> dict | None:
                 "last": round(vals[-1], 6),
             }
     return health
+
+
+def summarize_valuation(records: list[dict],
+                        flagged_ids: set[int] | None = None) -> dict | None:
+    """Aggregate schema-v7 ``valuation`` sub-objects: the latest
+    top-k/bottom-k client tables, the audit-correlation trail, and —
+    when the records carry raw per-client values — the overlay against
+    the client-health detector's flagged clients (an anomalous client
+    should show a depressed valuation; agreement between the two
+    independent signals is the check). None when no record carries
+    valuation data."""
+    vals = [
+        (r.get("round"), r["valuation"]) for r in records
+        if isinstance(r.get("valuation"), dict)
+    ]
+    if not vals:
+        return None
+    last_round, last = vals[-1]
+    audits = [
+        {"round": rnd, **v["audit"]}
+        for rnd, v in vals if isinstance(v.get("audit"), dict)
+    ]
+    summary: dict = {
+        "rounds_reported": len(vals),
+        "n_clients": last.get("n_clients"),
+        "last_round": last_round,
+        "top_clients": last.get("top_clients", []),
+        "bottom_clients": last.get("bottom_clients", []),
+        "loss_delta_curve": [
+            v.get("loss_delta") for _, v in vals
+        ],
+        "audits": audits,
+        "last_audit": audits[-1] if audits else None,
+    }
+    pc = last.get("per_client")
+    if flagged_ids and pc:
+        # Flagged-vs-valuation overlay: each detector-flagged client's
+        # current value and its rank (0 = most valuable). Rank is over
+        # descending value with stable ties.
+        ids = pc.get("client_ids", [])
+        values = pc.get("value", [])
+        by_id = dict(zip(ids, values))
+        order = sorted(
+            range(len(ids)), key=lambda i: -(values[i] or 0.0)
+        )
+        rank_of = {ids[i]: r for r, i in enumerate(order)}
+        summary["flagged_overlay"] = [
+            {
+                "id": cid,
+                "value": by_id.get(cid),
+                "rank": rank_of.get(cid),
+            }
+            for cid in sorted(flagged_ids)
+            if cid in by_id
+        ]
+    return summary
 
 
 def summarize_async(records: list[dict]) -> dict | None:
@@ -323,6 +383,15 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     if health is not None:
         summary["client_health"] = health
 
+    # --- valuation sub-objects (schema v7, client_valuation='on') -----------
+    flagged_ids: set[int] = set()
+    if health is not None:
+        for fr in health["flagged_rounds"]:
+            flagged_ids.update(int(c) for c in fr["flagged"])
+    valuation = summarize_valuation(records, flagged_ids or None)
+    if valuation is not None:
+        summary["valuation"] = valuation
+
     async_summary = summarize_async(records)
     if async_summary is not None:
         summary["async_federation"] = async_summary
@@ -474,6 +543,56 @@ def render_summary(summary: dict) -> list[str]:
                 lines.append(
                     f"    ... {len(loss_series) - 16} more client(s)"
                 )
+
+    if "valuation" in summary:
+        v = summary["valuation"]
+        lines.append(
+            f"client valuation: {v['rounds_reported']} round(s) of "
+            f"streaming scores over {v['n_clients']} client(s)"
+        )
+        deltas = [d for d in v["loss_delta_curve"] if d is not None]
+        if deltas:
+            lines.append(
+                f"  loss-delta curve: {sparkline(deltas)}  "
+                f"[{min(deltas):+.4g} .. {max(deltas):+.4g}]"
+            )
+
+        def _ranked(label, entries):
+            if not entries:
+                return
+            row = ", ".join(
+                f"{e['id']}:{e['value']:+.3g}" for e in entries
+            )
+            lines.append(f"  {label}: {row}")
+
+        _ranked("top clients   ", v["top_clients"])
+        _ranked("bottom clients", v["bottom_clients"])
+        for o in v.get("flagged_overlay", []):
+            # The incentive-side read of the anomaly detector: a flagged
+            # client sitting at a HIGH valuation rank is the surprising
+            # case worth a look — the two independent signals disagree.
+            val = "n/a" if o["value"] is None else f"{o['value']:+.3g}"
+            lines.append(
+                f"  !! flagged client {o['id']}: valuation {val} "
+                f"(rank {o['rank']}/{v['n_clients']}, 0 = most valuable)"
+            )
+        if v["last_audit"] is not None:
+            a = v["last_audit"]
+            hit = (
+                f", memo hit {a['memo_hit_rate']:.0%}"
+                if a.get("memo_hit_rate") is not None else ""
+            )
+            sp = a.get("spearman")
+            pe = a.get("pearson")
+            lines.append(
+                "  GTG audit (round {}): spearman {} pearson {} over {} "
+                "permutation(s), converged={}{}".format(
+                    a["round"],
+                    "n/a" if sp is None else f"{sp:.3f}",
+                    "n/a" if pe is None else f"{pe:.3f}",
+                    a["permutations"], a["converged"], hit,
+                )
+            )
 
     if "async_federation" in summary:
         a = summary["async_federation"]

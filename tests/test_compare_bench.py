@@ -256,6 +256,53 @@ def test_stream_overlap_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_valuation_corr_not_relatively_tracked(cb):
+    """The estimator-fidelity correlation sits near a fixed operating
+    point (~0.85-0.9) — like every other in-record ratio it must never
+    be a relative TRACKED metric; only the absolute floor judges it."""
+    old = _record(valuation={"audit_spearman": 0.95})
+    new = _record(valuation={"audit_spearman": 0.85})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "valuation" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_valuation_corr_self_gate(cb, tmp_path):
+    """In-record absolute floor: a streaming valuation vector that stops
+    tracking the exact GTG audit SVs gates on the NEW record alone."""
+    assert cb.valuation_corr_gate(_record(), 0.8) is None  # leg absent
+    ok = _record(valuation={"audit_spearman": 0.881,
+                            "overhead_ratio": 0.01})
+    assert cb.valuation_corr_gate(ok, 0.8) is None
+    # A null correlation (degenerate audit) is absent data, not a
+    # regression — the leg reports it, the gate skips it.
+    assert cb.valuation_corr_gate(
+        _record(valuation={"audit_spearman": None}), 0.8
+    ) is None
+    bad = _record(valuation={"audit_spearman": 0.41})
+    entry = cb.valuation_corr_gate(bad, 0.8)
+    assert entry and entry["new"] == 0.41 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "valuation.audit_spearman" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--valuation-corr-threshold", "0.3"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_model_drift_not_relatively_tracked(cb):
     """model_error_ratio sits near 1.0 — like the other in-record
     ratios it must never be a relative TRACKED metric (PR 4/5

@@ -4,11 +4,11 @@ Jax-free (imports only utils.reporting + jsonschema): the schema at
 tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
-(+client_stats), v4 (+async), v5 (+stream) and v6 (+costmodel) records
-must validate; records that mix versions and sub-objects inconsistently
-must not. The integration tests in test_client_stats.py (and
-test_costmodel.py for v6) validate REAL produced records against the
-same file.
+(+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel) and v7
+(+valuation) records must validate; records that mix versions and
+sub-objects inconsistently must not. The integration tests in
+test_client_stats.py (test_costmodel.py for v6, test_valuation.py for
+v7) validate REAL produced records against the same file.
 """
 
 import json
@@ -199,7 +199,7 @@ def test_v6_record_validates():
         _base(), _telemetry(), _client_stats(), _async(), _stream(),
         _costmodel(),
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 6
+    assert record["schema_version"] == 6
     validate(record)
     # costmodel alone (every other feature off) is still v6 — the
     # simulator's last-round record under cost_model_trace with
@@ -213,8 +213,60 @@ def test_v6_record_validates():
     }))
 
 
+def _valuation() -> dict:
+    return {
+        "n_clients": 4,
+        "updated": 3,
+        "loss_delta": 0.0412,
+        "top_clients": [{"id": 0, "value": 0.0051}, {"id": 3, "value": 0.0047}],
+        "bottom_clients": [{"id": 2, "value": 0.0012}, {"id": 1, "value": 0.003}],
+        "per_client": {
+            "client_ids": [0, 1, 2, 3],
+            "value": [0.0051, 0.003, 0.0012, 0.0047],
+        },
+        "audit": {
+            "spearman": 0.881, "pearson": 0.506, "spearman_round": 0.881,
+            "audits": 2, "permutations": 225, "subset_evals": 466,
+            "converged": True, "memo_hit_rate": None, "seconds": 2.48,
+        },
+    }
+
+
+def test_v7_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async(), _stream(),
+        _costmodel(), _valuation(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 7
+    validate(record)
+    # valuation alone (every other feature off) is still v7 — a
+    # client_valuation='on' run with telemetry_level='off' ... except
+    # valuation requires client_stats='on', so the realistic minimum
+    # carries both; the schema allows either.
+    validate(build_round_record(
+        _base(), None, None, None, None, None, _valuation()
+    ))
+    validate(build_round_record(
+        _base(), None, _client_stats(), None, None, None, _valuation()
+    ))
+    # Non-audit rounds carry no audit sub-object; degenerate
+    # correlations (all-zero vector on round 1) are null.
+    no_audit = {k: v for k, v in _valuation().items() if k != "audit"}
+    validate(build_round_record(
+        _base(), None, None, None, None, None, no_audit
+    ))
+    validate(build_round_record(
+        _base(), None, None, None, None, None,
+        {**_valuation(), "audit": {
+            "spearman": None, "pearson": None, "spearman_round": None,
+            "audits": 1, "permutations": 8, "subset_evals": 12,
+            "converged": False, "memo_hit_rate": 0.5, "seconds": 0.1,
+        }},
+    ))
+
+
 def test_lowest_version_stamping_preserved():
-    """Adding v6 must not disturb the lower stamps: the version is the
+    """Adding v7 must not disturb the lower stamps: the version is the
     LOWEST that describes the record (longitudinal byte-identity)."""
     assert "schema_version" not in build_round_record(_base())
     assert build_round_record(_base(), _telemetry())[
@@ -225,6 +277,8 @@ def test_lowest_version_stamping_preserved():
         "schema_version"] == 4
     assert build_round_record(_base(), None, None, None, _stream())[
         "schema_version"] == 5
+    assert build_round_record(_base(), None, None, None, None,
+                              _costmodel())["schema_version"] == 6
 
 
 def test_version_content_mismatches_rejected():
@@ -310,6 +364,31 @@ def test_version_content_mismatches_rejected():
     )
     with pytest.raises(jsonschema.ValidationError):
         validate(bad)
+    # v6 stamp smuggling a valuation sub-object (the builder always
+    # stamps valuation records v7).
+    bad = build_round_record(_base(), None, None, None, None, _costmodel())
+    bad["valuation"] = _valuation()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v7 stamp without the valuation sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 7
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown keys inside valuation (top level, the audit, a ranked
+    # entry) are schema breaks, not silent extensions.
+    for poison in (
+        {"mystery": 1},
+        {"audit": {**_valuation()["audit"], "mystery": 1}},
+        {"top_clients": [{"id": 0, "value": 1.0, "mystery": 1}]},
+        {"per_client": {"client_ids": [0], "value": [1.0], "mystery": 1}},
+    ):
+        bad = build_round_record(
+            _base(), None, None, None, None, None,
+            {**_valuation(), **poison},
+        )
+        with pytest.raises(jsonschema.ValidationError):
+            validate(bad)
 
 
 def test_missing_required_base_fields_rejected():
