@@ -50,12 +50,15 @@ Asynchronous federation) and records the simulated-clock
 ``async_speedup_ratio`` — compare_bench.py gates it absolutely
 (--async-speedup-threshold); BENCH_ASYNC=0 skips,
 BENCH_ASYNC_ROUNDS sets its length. The ``stream`` sub-object sweeps
-synthetic populations (10k -> 1M by default) under
-``client_residency='streamed'`` (docs/PERFORMANCE.md § Streamed client
-state) recording per-N cohort rates and the prefetch
-``overlap_ratio`` — compare_bench.py gates the largest N's ratio
-absolutely (--stream-overlap-threshold); BENCH_STREAM=0 skips,
-BENCH_STREAM_SWEEP/_COHORT/_SHARD/_ROUNDS set the sweep. The
+synthetic populations (10k -> 1M by default) x
+``participation_sampler`` modes (exact, hashed — ops/sampling.py)
+under ``client_residency='streamed'`` (docs/PERFORMANCE.md § Streamed
+client state) recording per-entry cohort rates, per-round cohort-draw
+``sample_ms``, and the prefetch ``overlap_ratio`` — compare_bench.py
+gates the largest N's ratio and cohort rate absolutely
+(--stream-overlap-threshold / --stream-cohort-rate-threshold, both
+read at the fastest-supported sampler); BENCH_STREAM=0 skips,
+BENCH_STREAM_SWEEP/_SAMPLERS/_COHORT/_SHARD/_ROUNDS set the sweep. The
 ``costmodel`` sub-object (telemetry/costmodel.py) evaluates the proxy
 legs' categorized op ledgers through the roofline model: predicted
 per-round time for every topology-table entry, per-category bottleneck
@@ -170,7 +173,7 @@ def _proxy_stats(config, dataset, client_data, rounds: int = 3) -> dict:
 
 
 def _stream_leg() -> dict:
-    """Streamed-residency N-sweep (see the run_stream block in main()).
+    """Streamed-residency N x sampler sweep (see run_stream in main()).
 
     Uses the synthetic dataset so the POPULATION axis scales without a
     50k-sample cap: every client's shard is drawn from a small pool by
@@ -179,6 +182,19 @@ def _stream_leg() -> dict:
     N=1e6). The pool is min-max scaled into [0, 1] so the shards keep
     the uint8-compact layout (1 byte/feature: a million 16-sample
     shards of the 8x8x1 synthetic stay ~1 GB host-side).
+
+    Each population is run once per ``participation_sampler`` mode
+    (``BENCH_STREAM_SAMPLERS``, default "exact,hashed" —
+    ops/sampling.py): ``exact``'s O(N log N) cohort replay is the
+    measured host-bound ceiling at N=1e6 and ``hashed``'s O(cohort)
+    draw is what removes it; each entry records the steady
+    ``cohort_rate`` and the mean per-round ``sample_ms`` so the draw
+    cost is visible next to the throughput it binds. The gate numbers
+    (``overlap_ratio``, ``cohort_rate``) come from the LARGEST
+    population under its FASTEST-supported sampler — hashed when swept,
+    the operating point the sampler exists for
+    (scripts/compare_bench.py --stream-overlap-threshold /
+    --stream-cohort-rate-threshold).
     """
     from distributed_learning_simulator_tpu.config import ExperimentConfig
     from distributed_learning_simulator_tpu.data.registry import get_dataset
@@ -194,6 +210,13 @@ def _stream_leg() -> dict:
     )
     if not sweep:
         return {"error": "BENCH_STREAM_SWEEP is empty"}
+    samplers = [
+        s.strip() for s in os.environ.get(
+            "BENCH_STREAM_SAMPLERS", "exact,hashed"
+        ).split(",") if s.strip()
+    ]
+    if not samplers:
+        return {"error": "BENCH_STREAM_SAMPLERS is empty"}
     cohort = int(os.environ.get("BENCH_STREAM_COHORT", "256"))
     shard = int(os.environ.get("BENCH_STREAM_SHARD", "16"))
     s_rounds = int(os.environ.get("BENCH_STREAM_ROUNDS", "8"))
@@ -212,38 +235,61 @@ def _stream_leg() -> dict:
         client_data = synthetic_stream_shards(
             ds_scaled.x_train, ds_scaled.y_train, n, shard, seed=0
         )
-        s_config = ExperimentConfig(
-            dataset_name="synthetic", model_name="mlp",
-            distributed_algorithm="fed", worker_number=n,
-            round=s_rounds + 1, epoch=1, learning_rate=0.1,
-            batch_size=shard, eval_batch_size=512,
-            participation_fraction=cohort / n,
-            client_residency="streamed", log_level="WARNING",
-        )
-        times, result = _run(
-            s_config, dataset=ds_scaled, client_data=client_data
-        )
-        steady = times[1:]
-        out["sweep"].append({
-            "n_clients": n,
-            "config_hash": config_hash(s_config),
-            # Only the cohort trains per round: cohort*rounds/s is the
-            # honest throughput unit for a sampled population.
-            "cohort_rate": round(cohort * len(steady) / sum(steady), 2),
-            "round_ms": round(
-                statistics.median(steady) * 1e3, 2
-            ),
-            "overlap_ratio": round(result["stream_overlap_ratio"], 4),
-            "h2d_mb": round(result["stream_h2d_bytes"] / 2**20, 2),
-            "host_store_mb": round(
-                (client_data.x.nbytes + client_data.y.nbytes
-                 + client_data.mask.nbytes + client_data.sizes.nbytes)
-                / 2**20, 1
-            ),
-        })
-    # The gate reads the LARGEST population's ratio — the operating
-    # point the feature exists for.
-    out["overlap_ratio"] = out["sweep"][-1]["overlap_ratio"]
+        for sampler in samplers:
+            s_config = ExperimentConfig(
+                dataset_name="synthetic", model_name="mlp",
+                distributed_algorithm="fed", worker_number=n,
+                round=s_rounds + 1, epoch=1, learning_rate=0.1,
+                batch_size=shard, eval_batch_size=512,
+                participation_fraction=cohort / n,
+                participation_sampler=sampler,
+                client_residency="streamed", log_level="WARNING",
+            )
+            times, result = _run(
+                s_config, dataset=ds_scaled, client_data=client_data
+            )
+            steady = times[1:]
+            # Steady per-round cohort-draw replay cost — the host time
+            # the sampler knob exists to shrink (~1-2 s/round for exact
+            # at N=1e6 vs sub-ms hashed). Median over the steady
+            # rounds' stream records: round 0's draw carries the
+            # replay-path jit warmup, which is startup cost, not the
+            # per-round cost being tracked.
+            sample_steady = [
+                h["stream"]["sample_ms"] for h in result["history"][1:]
+                if "sample_ms" in h.get("stream", {})
+            ]
+            out["sweep"].append({
+                "n_clients": n,
+                "sampler": sampler,
+                "config_hash": config_hash(s_config),
+                # Only the cohort trains per round: cohort*rounds/s is
+                # the honest throughput unit for a sampled population.
+                "cohort_rate": round(cohort * len(steady) / sum(steady), 2),
+                "round_ms": round(
+                    statistics.median(steady) * 1e3, 2
+                ),
+                "sample_ms": round(
+                    statistics.median(sample_steady), 3
+                ) if sample_steady else None,
+                "overlap_ratio": round(result["stream_overlap_ratio"], 4),
+                "h2d_mb": round(result["stream_h2d_bytes"] / 2**20, 2),
+                "host_store_mb": round(
+                    (client_data.x.nbytes + client_data.y.nbytes
+                     + client_data.mask.nbytes + client_data.sizes.nbytes)
+                    / 2**20, 1
+                ),
+            })
+    # The gates read the LARGEST population under its fastest-supported
+    # sampler — the operating point the feature exists for.
+    gate_sampler = "hashed" if "hashed" in samplers else samplers[-1]
+    gate_entry = [
+        e for e in out["sweep"]
+        if e["n_clients"] == sweep[-1] and e["sampler"] == gate_sampler
+    ][-1]
+    out["overlap_ratio"] = gate_entry["overlap_ratio"]
+    out["cohort_rate"] = gate_entry["cohort_rate"]
+    out["sampler"] = gate_sampler
     out["max_n"] = sweep[-1]
     return out
 

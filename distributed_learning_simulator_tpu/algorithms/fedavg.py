@@ -28,6 +28,10 @@ from distributed_learning_simulator_tpu.ops.cohort import (
     cohort_scatter,
     cohort_take,
 )
+from distributed_learning_simulator_tpu.ops.sampling import (
+    draw_cohort,
+    draw_cohort_host,
+)
 from distributed_learning_simulator_tpu.parallel.engine import (
     chunked_accumulate,
     make_local_train_fn,
@@ -66,6 +70,28 @@ def round_key_splits(key, with_faults: bool):
         )
         fault_key = None
     return part_key, train_key, payload_key, agg_key, fault_key
+
+
+#: One jitted program per fault-gating flavor of the round-key split:
+#: ``round_key -> key_data(round_key_splits(round_key, wf)[0])``. The
+#: hashed host replay runs once per round; composing the split +
+#: key_data EAGERLY costs ~10 ms of per-op dispatch overhead — 50x the
+#: O(cohort) draw itself — so the chain is compiled once and dispatched
+#: as one call. Built FROM :func:`round_key_splits` (never a re-spelled
+#: split width) so a future change to the split chain flows into the
+#: hashed replay automatically — the one-copy discipline.
+_HASHED_PART_WORDS_JIT: dict = {}
+
+
+def _hashed_part_key_words(round_key, with_faults: bool):
+    fn = _HASHED_PART_WORDS_JIT.get(with_faults)
+    if fn is None:
+        def _words(key, _wf=with_faults):
+            return jax.random.key_data(round_key_splits(key, _wf)[0])
+
+        fn = jax.jit(_words)
+        _HASHED_PART_WORDS_JIT[with_faults] = fn
+    return np.asarray(fn(round_key)).ravel()
 
 
 class FedAvg(Algorithm):
@@ -207,24 +233,35 @@ class FedAvg(Algorithm):
         """Host-replay of the round program's cohort draw (base contract).
 
         MUST mirror ``split_round_key`` + the in-program
-        ``jax.random.choice`` in ``make_round_fn`` exactly: part_key is
-        split index 0 of the 4-way (or, with a failure model, 5-way)
-        round-key split. The streamer runs this on the CPU backend; jax
-        PRNG draws are backend-deterministic, so the streamed cohort is
-        the resident cohort bit-for-bit.
+        ``ops/sampling.draw_cohort`` in ``make_round_fn`` exactly:
+        part_key is split index 0 of the 4-way (or, with a failure
+        model, 5-way) round-key split, and both call sites consume the
+        ONE sampler implementation, so they can never drift. Under the
+        ``exact`` sampler the streamer runs this on the CPU backend and
+        jax PRNG draws are backend-deterministic (the streamed cohort
+        is the resident cohort bit-for-bit); under ``hashed`` the
+        replay is the O(cohort) numpy mirror of the same keyed-hash
+        stream — identical indices by construction, no full-N work.
         """
         cfg = self.config
         n_participants = cfg.cohort_size(n_clients)
         if n_participants == n_clients:
             return None
-        part_key = round_key_splits(
-            round_key, FailureModel.from_config(cfg) is not None
-        )[0]
-        return np.asarray(
-            jax.random.choice(
-                part_key, n_clients, (n_participants,), replace=False
+        with_faults = FailureModel.from_config(cfg) is not None
+        sampler = getattr(cfg, "participation_sampler", "exact").lower()
+        if sampler == "hashed":
+            # O(cohort) replay end to end: the round_key_splits +
+            # key_data chain runs as ONE jitted call
+            # (_hashed_part_key_words — eager per-op dispatch costs
+            # more than the whole hashed draw); the draw itself stays
+            # in draw_cohort_host, the one host entry. Bit-identical
+            # indices to the in-program draw_cohort by construction.
+            return draw_cohort_host(
+                None, n_clients, n_participants, sampler,
+                key_words=_hashed_part_key_words(round_key, with_faults),
             )
-        )
+        part_key = round_key_splits(round_key, with_faults)[0]
+        return draw_cohort_host(part_key, n_clients, n_participants, sampler)
 
     def make_round_fn(self, apply_fn, optimizer, n_clients: int,
                       preprocess=None, client_sizes=None):
@@ -871,9 +908,13 @@ class FedAvg(Algorithm):
             else:
                 # Client sampling: train only the sampled cohort (fixed size
                 # -> one compilation); non-participants keep their state and
-                # contribute nothing to aggregation.
-                idx = jax.random.choice(
-                    keys[0], n_clients, (n_participants,), replace=False
+                # contribute nothing to aggregation. The draw is the ONE
+                # sampler implementation (ops/sampling.py) shared with the
+                # host replay in cohort_indices — exact = the pre-feature
+                # choice(replace=False), hashed = the O(cohort) keyed draw.
+                idx = draw_cohort(
+                    keys[0], n_clients, n_participants,
+                    getattr(cfg, "participation_sampler", "exact").lower(),
                 )
                 state_k = cohort_take(client_state, idx)
                 x_k, y_k, m_k = (

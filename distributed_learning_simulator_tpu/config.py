@@ -25,6 +25,11 @@ TELEMETRY_LEVELS = ("off", "basic", "detailed")
 # import-light placement rationale as TELEMETRY_LEVELS.
 CLIENT_STATS_LEVELS = ("off", "on")
 
+# Valid participation_sampler values (ops/sampling.py, which re-exports
+# this as SAMPLERS). Same import-light placement rationale as
+# TELEMETRY_LEVELS — ops.sampling imports jax.
+PARTICIPATION_SAMPLERS = ("exact", "hashed")
+
 
 @dataclass
 class ExperimentConfig:
@@ -310,11 +315,13 @@ class ExperimentConfig:
     # (docs/PERFORMANCE.md § Streamed client state). Bit-identical to
     # 'resident' at any N: the cohort index sequence is host-replayed
     # from the round-key chain, so sampling/fault/training draws are
-    # unchanged. vmap execution only; refuses mesh/multihost sharding
-    # (the cohort slice layout would fight the PartitionSpec) and
-    # algorithms that don't opt in (Algorithm.supports_streamed_residency
-    # — the Shapley family's subset re-evaluation assumes a resident
-    # stack).
+    # unchanged. vmap execution only; single-host mesh sharding
+    # COMPOSES (the streamer uploads the cohort slice straight into the
+    # client-axis PartitionSpec layout — the cohort must divide
+    # mesh_devices); refuses multihost (the host shard store is
+    # single-process) and algorithms that don't opt in
+    # (Algorithm.supports_streamed_residency — the Shapley family's
+    # subset re-evaluation assumes a resident stack).
     client_residency: str = "resident"
     # Fraction of clients sampled (without replacement) to train+aggregate
     # each round (FedAvg-family). 1.0 = all clients, the reference's fixed
@@ -322,6 +329,22 @@ class ExperimentConfig:
     # reference's barrier (fed_server.py:75-77, which hangs forever if a
     # client goes missing), non-participants simply sit the round out.
     participation_fraction: float = 1.0
+    # HOW the cohort is drawn from the round key (ops/sampling.py).
+    # "exact" (default): the bit-identical pre-feature
+    # jax.random.choice(replace=False) — a full O(N log N) permutation
+    # per round, ~1 s at N=1e6 on a CPU host, which is what left the
+    # streamed-residency stream leg host-bound. "hashed": an O(cohort)
+    # counter-based Threefry draw (first-k-distinct of a keyed hash
+    # stream, duplicates rejected in a fixed small over-draw buffer —
+    # no full-N permutation or memory anywhere, numpy-mirrored on the
+    # streamed host-replay path). A NEW sampling mode, deliberately not
+    # bit-identical to 'exact' (gated and documented like
+    # client_residency), but uniform, duplicate-free, deterministic
+    # from the round-key chain, and identical between the in-program
+    # draw and the host replay by construction. A program-defining knob:
+    # 'hashed' lands in config_hash; 'exact' keeps pre-feature hashes
+    # (docs/PERFORMANCE.md § Streamed client state has the guidance).
+    participation_sampler: str = "exact"
     # Defer each round's metric fetch + post_round by one round so the
     # device->host transfer latency overlaps the next round's compute
     # (significant when the chip sits behind a high-latency link). Auto-
@@ -499,6 +522,12 @@ class ExperimentConfig:
             raise ValueError(f"unknown partition {self.partition!r}")
         if not 0.0 < self.participation_fraction <= 1.0:
             raise ValueError("participation_fraction must be in (0, 1]")
+        if self.participation_sampler.lower() not in PARTICIPATION_SAMPLERS:
+            raise ValueError(
+                f"unknown participation_sampler "
+                f"{self.participation_sampler!r}; known: "
+                + ", ".join(PARTICIPATION_SAMPLERS)
+            )
         if self.compilation_cache_dir in ("", "none", "None"):
             self.compilation_cache_dir = None
         if self.cost_model_trace_rounds < 1:
@@ -673,15 +702,20 @@ class ExperimentConfig:
                     "execution mode (the threaded oracle owns its own "
                     "per-worker data)"
                 )
-            if self.multihost or (
-                self.mesh_devices is not None and self.mesh_devices > 1
-            ):
+            if self.multihost:
+                # Single-host mesh sharding composes (the streamer
+                # uploads each cohort slice directly into the
+                # client-axis PartitionSpec layout — parallel/
+                # streaming.py); multi-HOST does not yet: the host
+                # shard store lives in ONE process's RAM, and every
+                # other process would need its cohort shard shipped
+                # over DCN each dispatch.
                 raise ValueError(
                     "client_residency='streamed' does not compose with "
-                    "mesh/multihost sharding: the per-dispatch cohort "
-                    "upload would fight the client-axis PartitionSpec; "
-                    "use client_residency='resident' with mesh_devices, "
-                    "or streamed on a single device"
+                    "multihost: the host shard store is single-process "
+                    "(each remote host's cohort shard would cross DCN "
+                    "every dispatch); use client_residency='resident' "
+                    "with multihost, or streamed on one host's mesh"
                 )
         if self.rounds_per_dispatch < 1:
             raise ValueError("rounds_per_dispatch must be >= 1")

@@ -18,6 +18,12 @@ from distributed_learning_simulator_tpu.ops.payload import (
     sign_payload_bytes,
     compression_ratio,
 )
+from distributed_learning_simulator_tpu.ops.sampling import (
+    draw_cohort,
+    draw_cohort_host,
+    hashed_cohort,
+    hashed_cohort_np,
+)
 
 __all__ = [
     "weighted_mean",
@@ -35,4 +41,8 @@ __all__ = [
     "quantized_payload_bytes",
     "sign_payload_bytes",
     "compression_ratio",
+    "draw_cohort",
+    "draw_cohort_host",
+    "hashed_cohort",
+    "hashed_cohort_np",
 ]

@@ -1,0 +1,294 @@
+"""Participation sampling: the ONE copy of the cohort draw.
+
+``config.participation_sampler`` selects how the round's cohort (the
+``cohort_size()`` participants of a ``participation_fraction < 1``
+round) is drawn from the round key's ``part_key``:
+
+* ``exact`` (default) — the bit-identical pre-feature draw:
+  ``jax.random.choice(part_key, N, (k,), replace=False)``. Uniform over
+  ordered k-subsets, but a full O(N log N) permutation per draw — ~1 s
+  at N=1e6 on a CPU host, which is what left the streamed-residency
+  stream leg host-bound (docs/PERFORMANCE.md § Streamed client state).
+* ``hashed`` — an O(cohort) counter-based draw: a Threefry-2x32 keyed
+  hash over a draw counter yields a deterministic stream of EXACTLY
+  uniform client indices (values past the largest uint32 multiple of N
+  are rejected before the modulo — see :func:`_mod_limit` — so there
+  is no modulo bias), and the cohort is the FIRST k DISTINCT values of
+  that stream (duplicates rejected inside a fixed small over-draw
+  block — no full-N permutation, no full-N memory anywhere). Deliberately NOT
+  bit-identical to ``exact`` (it is a new sampling mode, gated and
+  documented like ``client_residency`` itself), but uniform
+  (chi-square-tested, tests/test_sampling.py), duplicate-free, and
+  deterministic from the round-key chain.
+
+Both modes are implemented ONCE here and consumed by every cohort-index
+producer — the in-program draw in ``algorithms/fedavg.round_fn``
+(:func:`draw_cohort`), the streamed-residency host replay
+``Algorithm.cohort_indices`` (:func:`draw_cohort_host`), and through
+those two, the PR 2/6 fault/arrival key discipline and the valuation
+auditor's ``participants`` consumption — so the producers can never
+drift again (they used to be two hand-copied ``jax.random.choice``
+calls).
+
+The hashed draw's defining property: the selected cohort is a pure
+function of (key bits, N, k) — the "first k distinct of the counter
+stream" semantics make it independent of the over-draw block size, so
+the jitted fixed-shape loop and the numpy mirror
+(:func:`hashed_cohort_np`, used on the host replay path where eager
+jax dispatch of a while_loop would dominate the O(cohort) work) agree
+element-for-element by construction. The Threefry math is written once
+over the array-module argument ``xp`` (numpy and jax.numpy share the
+API) so the two backends cannot diverge.
+
+Cost note: expected draws to find k distinct of N is
+``N * ln(N / (N - k))`` — ~k for k << N (the regime the sampler exists
+for), degrading smoothly toward coupon-collector O(N log N) draws as
+``participation_fraction`` approaches 1, where ``exact`` is the better
+tool anyway (mode-choice guidance: docs/PERFORMANCE.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Valid participation_sampler values. Defined in config.py (the
+#: import-light home of valid-value tuples, like TELEMETRY_LEVELS) and
+#: re-exported here next to the implementations.
+from distributed_learning_simulator_tpu.config import (  # noqa: E402
+    PARTICIPATION_SAMPLERS as SAMPLERS,
+)
+
+# Threefry-2x32 constants (Salmon et al., SC'11): 4-round rotation
+# schedules and the key-schedule parity word.
+_ROTS_A = (13, 15, 26, 6)
+_ROTS_B = (17, 29, 16, 24)
+_PARITY = 0x1BD11BDA
+
+
+def threefry2x32(xp, k0, k1, x0, x1):
+    """Threefry-2x32, 20 rounds, written over the array module ``xp``.
+
+    ``k0``/``k1`` are uint32 key words, ``x0``/``x1`` uint32 counter
+    arrays (or scalars). Returns the two output words. One
+    implementation serves both backends: ``xp=jnp`` traces into the
+    round program, ``xp=np`` runs the host mirror — uint32 arithmetic
+    wraps identically in both, which is what the jit==numpy equality
+    contract (tests/test_sampling.py) rests on.
+    """
+    ks0 = xp.asarray(k0, xp.uint32)
+    ks1 = xp.asarray(k1, xp.uint32)
+    ks2 = ks0 ^ ks1 ^ xp.uint32(_PARITY)
+    ks = (ks0, ks1, ks2)
+    x0 = xp.asarray(x0, xp.uint32) + ks0
+    x1 = xp.asarray(x1, xp.uint32) + ks1
+    for i in range(5):
+        for r in _ROTS_A if i % 2 == 0 else _ROTS_B:
+            x0 = x0 + x1
+            x1 = (x1 << xp.uint32(r)) | (x1 >> xp.uint32(32 - r))
+            x1 = x1 ^ x0
+        x0 = x0 + ks[(i + 1) % 3]
+        x1 = x1 + ks[(i + 2) % 3] + xp.uint32(i + 1)
+    return x0, x1
+
+
+def _key_words(part_key):
+    """The two uint32 key words of a jax PRNG key (threefry key data).
+
+    Works on traced keys (in-program draw) and concrete ones (host
+    replay); the bits are backend-independent, which is what keeps the
+    host mirror exact.
+    """
+    kd = jnp.ravel(jax.random.key_data(part_key))
+    return kd[0].astype(jnp.uint32), kd[1].astype(jnp.uint32)
+
+
+def overdraw_block(k: int, n: int) -> int:
+    """Fixed over-draw block size for the hashed draw's rejection buffer.
+
+    Sized so ONE block almost always yields k distinct values: k slots,
+    a constant margin, plus FOUR times the ~B^2/(2N) expected in-block
+    collisions (the deliberate safety factor — a Poisson tail at 4x its
+    mean is negligible, and a too-small block only costs a second loop
+    iteration, never correctness). The SELECTION is block-size
+    independent ("first k distinct of the stream"), so this only tunes
+    how often the fixed-shape loop iterates — capped at 4k+64 so a
+    near-1 participation fraction cannot explode the in-program buffer.
+    """
+    if k <= 0:
+        return 64
+    b = k + 64
+    b = k + 64 + int(4.0 * b * b / (2 * max(n, 1)))
+    return max(min(b, 4 * k + 64), 1)
+
+
+def _mod_limit(n: int) -> int:
+    """Largest multiple of ``n`` representable in uint32 counters.
+
+    Stream values at or above it are REJECTED before the ``% n`` so the
+    kept indices are exactly uniform — a plain modulo would over-sample
+    client ids below ``2**32 % n`` by ~n/2**32 relative probability
+    (tiny, but systematic across every round of a long run). At most
+    one value in ~4295 is rejected (n <= 2**20-ish populations), so the
+    over-draw sizing is unaffected.
+    """
+    return (2**32 // n) * n
+
+
+def _hashed_block_np(k0: np.uint32, k1: np.uint32, start: int, size: int,
+                     n: int) -> np.ndarray:
+    """``size`` stream positions starting at counter ``start``: exactly
+    uniform int64 indices in [0, n), with modulo-bias rejections marked
+    as -1 (numpy backend; the jnp path marks the same positions)."""
+    ctr = np.arange(start, start + size, dtype=np.uint32)
+    v0, _ = threefry2x32(np, k0, k1, ctr, np.zeros(size, np.uint32))
+    vals = (v0 % np.uint32(n)).astype(np.int64)
+    limit = _mod_limit(n)
+    if limit < 2**32:  # n divides 2^32 exactly -> nothing to reject
+        vals = np.where(v0 < np.uint32(limit), vals, -1)
+    return vals
+
+
+def hashed_cohort_np(key_words, n: int, k: int,
+                     block: int | None = None) -> np.ndarray:
+    """Numpy mirror of the hashed draw: first k distinct stream values.
+
+    ``key_words`` is the uint32[>=2] key-data array
+    (``np.asarray(jax.random.key_data(part_key)).ravel()``). O(k)
+    expected work for k << N — the host replay path
+    (``Algorithm.cohort_indices``) runs THIS, not the jitted loop,
+    because at cohort=256 the draw is a few microseconds of numpy and
+    must never cost a device round-trip.
+    """
+    if not 0 < k <= n:
+        raise ValueError(f"need 0 < k <= n, got k={k}, n={n}")
+    kw = np.asarray(key_words).ravel()
+    k0, k1 = np.uint32(kw[0]), np.uint32(kw[1])
+    size = block or overdraw_block(k, n)
+    out = np.empty(k, dtype=np.int64)
+    count = 0
+    start = 0
+    while count < k:
+        vals = _hashed_block_np(k0, k1, start, size, n)
+        start += size
+        # First occurrence within the block, in stream order...
+        _, first = np.unique(vals, return_index=True)
+        keep = np.zeros(vals.size, dtype=bool)
+        keep[first] = True
+        # ... minus modulo-bias rejections (-1) and anything already
+        # selected in earlier blocks.
+        keep &= vals >= 0
+        keep &= ~np.isin(vals, out[:count])
+        fresh = vals[keep][: k - count]
+        out[count : count + fresh.size] = fresh
+        count += fresh.size
+    return out
+
+
+def hashed_cohort(part_key, n: int, k: int, block: int | None = None):
+    """Jitted hashed draw: int32[k] cohort, identical to the numpy
+    mirror element-for-element (same stream, same first-k-distinct
+    selection; the fixed-shape ``lax.while_loop`` only changes where
+    the rejection runs, never what is selected)."""
+    if not 0 < k <= n:
+        raise ValueError(f"need 0 < k <= n, got k={k}, n={n}")
+    k0, k1 = _key_words(part_key)
+    size = block or overdraw_block(k, n)
+    arange_b = jnp.arange(size, dtype=jnp.uint32)
+    zeros_b = jnp.zeros(size, jnp.uint32)
+
+    def cond(state):
+        _, count, _ = state
+        return count < k
+
+    limit = _mod_limit(n)
+
+    def body(state):
+        sel, count, start = state
+        v0, _ = threefry2x32(jnp, k0, k1, start + arange_b, zeros_b)
+        vals = (v0 % jnp.uint32(n)).astype(jnp.int32)
+        if limit < 2**32:
+            # Modulo-bias rejection, mirroring the numpy path: stream
+            # values past the largest multiple of n are marked -1 (the
+            # trace-time gate drops the compare entirely when n divides
+            # 2^32).
+            vals = jnp.where(v0 < jnp.uint32(limit), vals, -1)
+        # Stream-order first occurrence within the block: a value is a
+        # duplicate if an EARLIER position holds it (strict lower
+        # triangle of the equality matrix — O(B^2) compares on a small
+        # fixed block, trivially cheap next to a training round).
+        eq = vals[:, None] == vals[None, :]
+        dup_within = jnp.tril(eq, -1).any(axis=1)
+        # ... and against every value selected in earlier blocks (the
+        # -1 sentinel rows never match a valid index).
+        seen = (vals[:, None] == sel[None, :]).any(axis=1)
+        fresh = (vals >= 0) & (~dup_within) & (~seen)
+        rank = jnp.cumsum(fresh) - 1 + count
+        take = fresh & (rank < k)
+        # Scatter the taken values at their ranks; everything else
+        # lands on the k-th dummy slot (dropped by the final slice).
+        pos = jnp.where(take, rank, k)
+        sel = sel.at[pos].set(vals)
+        return sel, count + jnp.sum(take), start + jnp.uint32(size)
+
+    sel0 = jnp.full(k + 1, -1, dtype=jnp.int32)
+    sel, _, _ = jax.lax.while_loop(
+        cond, body, (sel0, jnp.asarray(0, jnp.int32), jnp.uint32(0))
+    )
+    return sel[:k]
+
+
+def draw_cohort(part_key, n_clients: int, n_participants: int,
+                sampler: str = "exact"):
+    """In-program cohort draw — the one entry the round program traces.
+
+    ``exact`` is byte-for-byte the pre-feature
+    ``jax.random.choice(replace=False)`` (the bit-identity pin);
+    ``hashed`` is the O(cohort) keyed-hash draw. Both return the
+    cohort's true client ids with a leading axis of ``n_participants``.
+    """
+    if sampler == "exact":
+        return jax.random.choice(
+            part_key, n_clients, (n_participants,), replace=False
+        )
+    if sampler == "hashed":
+        return hashed_cohort(part_key, n_clients, n_participants)
+    raise ValueError(
+        f"unknown participation_sampler {sampler!r}; known: "
+        + ", ".join(SAMPLERS)
+    )
+
+
+def draw_cohort_host(part_key, n_clients: int, n_participants: int,
+                     sampler: str = "exact", *,
+                     key_words=None) -> np.ndarray:
+    """Host replay of :func:`draw_cohort` (``Algorithm.cohort_indices``)
+    — the ONE host entry for both modes.
+
+    ``exact`` runs the SAME ``jax.random.choice`` (jax PRNG draws are
+    backend-deterministic, so the CPU replay is the in-program draw
+    bit-for-bit — the PR 7 discipline, at its O(N log N) cost);
+    ``hashed`` runs the numpy mirror in O(cohort) — no full-N work, no
+    full-N memory, which is what flips the million-client stream leg
+    from host-bound to model-bound. ``key_words`` optionally supplies
+    the part_key's raw uint32 words for the hashed path (callers that
+    derive them through a jitted split chain —
+    ``fedavg._hashed_part_key_words`` — pass them here so the hashed
+    composition itself still lives in exactly one place; ``part_key``
+    may then be None).
+    """
+    if sampler == "exact":
+        return np.asarray(
+            jax.random.choice(
+                part_key, n_clients, (n_participants,), replace=False
+            )
+        )
+    if sampler == "hashed":
+        if key_words is None:
+            key_words = np.asarray(jax.random.key_data(part_key)).ravel()
+        return hashed_cohort_np(key_words, n_clients, n_participants)
+    raise ValueError(
+        f"unknown participation_sampler {sampler!r}; known: "
+        + ", ".join(SAMPLERS)
+    )

@@ -26,7 +26,23 @@ Every transfer is timed and byte-counted; the per-dispatch stats become
 the schema-v5 ``stream`` sub-object of the metrics record
 (utils/reporting.py) and the run totals feed the result dict's
 ``stream_overlap_ratio`` (bench.py's ``stream`` leg gates it through
-scripts/compare_bench.py --stream-overlap-threshold).
+scripts/compare_bench.py --stream-overlap-threshold). The cohort-draw
+replay is timed too (the ``sample`` phase + the stream record's
+``sampler``/``sample_ms`` fields): at N=1e6 the exact replay is the
+~1 s host cost that used to hide inside ``client_step``
+(``participation_sampler='hashed'`` removes it — ops/sampling.py).
+
+**Mesh composition** (``mesh_devices > 1`` + streamed, single host):
+the streamer uploads each cohort slice directly into the client-axis
+``PartitionSpec`` layout — one ``jax.device_put`` per array against a
+``NamedSharding`` whose client axis is the slice's cohort axis (axis 0
+per-round, axis 1 for a stacked ``[k, cohort, ...]`` batched
+dispatch), so the host->device transfer is split per shard by the
+mesh's client-axis ownership and the round program consumes the slice
+without a resharding copy. Double buffering is unchanged (the worker
+thread's device_put targets the sharded layout directly) and the
+writeback ``device_get`` gathers shard-local cohort state back to the
+host store.
 """
 
 from __future__ import annotations
@@ -63,7 +79,7 @@ class CohortStreamer:
     """
 
     def __init__(self, store: HostShardStore, algorithm, n_clients: int,
-                 device=None):
+                 device=None, mesh=None):
         self.store = store
         self._algorithm = algorithm
         self._n = n_clients
@@ -75,6 +91,20 @@ class CohortStreamer:
         # different sharding signature than round 0's and the round
         # program compiles twice (one spurious post-warmup compile).
         self._device = device
+        # mesh (single-host client-axis mesh, parallel/mesh.py): uploads
+        # device_put against a NamedSharding whose client axis is the
+        # slice's cohort axis — the per-shard transfer addressed by the
+        # mesh's client-axis ownership. Mutually exclusive with device.
+        self._mesh = mesh
+        # Per-round cohort-replay timing (ops/sampling.py modes): the
+        # pending seconds drain into the next acquire's stats as
+        # ``sample_ms``; ``last_sample_seconds`` lets the host loop carve
+        # the draw out of the enclosing phase window (telemetry/phases).
+        self._sampler = getattr(
+            algorithm.config, "participation_sampler", "exact"
+        ).lower()
+        self._sample_pending = 0.0
+        self.last_sample_seconds = 0.0
         # Cohort replay runs on the CPU backend when one exists: jax PRNG
         # draws are backend-deterministic, and tiny eager choice/split ops
         # must not interleave with the accelerator's round program.
@@ -89,17 +119,42 @@ class CohortStreamer:
         # Run totals (the result dict's stream_* fields).
         self.totals = {
             "h2d_bytes": 0, "h2d_seconds": 0.0, "hidden_seconds": 0.0,
-            "d2h_bytes": 0, "d2h_seconds": 0.0,
+            "d2h_bytes": 0, "d2h_seconds": 0.0, "sample_seconds": 0.0,
         }
+
+    def _placed(self, a, client_axis: int):
+        """device_put one upload array: uncommitted default device
+        (single-device runs), the explicit device, or — under a mesh —
+        the client-axis NamedSharding with the cohort axis at
+        ``client_axis`` (0 for a per-round slice, 1 for a stacked
+        ``[k, cohort, ...]`` batched dispatch)."""
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            spec = PartitionSpec(
+                *([None] * client_axis), self._mesh.axis_names[0]
+            )
+            return jax.device_put(a, NamedSharding(self._mesh, spec))
+        if self._device is not None:
+            return jax.device_put(a, self._device)
+        return jax.device_put(a)
 
     # ---- cohort replay -----------------------------------------------------
     def cohort_for(self, round_key):
         """Host replay of the cohort the round program draws from
         ``round_key`` (Algorithm.cohort_indices contract): a host numpy
-        index array, or None when the cohort is the whole population."""
+        index array, or None when the cohort is the whole population.
+        Timed: the draw cost (the exact replay's O(N log N) permutation
+        vs the hashed mode's O(cohort) hash — ops/sampling.py) lands in
+        the next acquire's ``sample_ms`` and the ``sample`` phase."""
+        t0 = time.perf_counter()
         if self._cpu is not None:
             round_key = jax.device_put(round_key, self._cpu)
         idx = self._algorithm.cohort_indices(round_key, self._n)
+        dt = time.perf_counter() - t0
+        self._sample_pending += dt
+        self.last_sample_seconds = dt
+        self.totals["sample_seconds"] += dt
         return None if idx is None else np.asarray(idx)
 
     # ---- upload / prefetch -------------------------------------------------
@@ -130,12 +185,12 @@ class CohortStreamer:
                 [np.asarray(idx, dtype=np.int32) for idx in idx_list]
             )
         host_arrays = (x, y, m, s, idx_arr)
+        # Cohort axis: leading for a per-round slice, axis 1 behind the
+        # round axis for a stacked batched dispatch — the mesh placement
+        # shards exactly that axis (PartitionSpec layout).
+        client_axis = 1 if stack else 0
         arrays = tuple(
-            None if a is None
-            else (
-                jax.device_put(a) if self._device is None
-                else jax.device_put(a, self._device)
-            )
+            None if a is None else self._placed(a, client_axis)
             for a in host_arrays
         )
         # device_put is asynchronous; the transfer is only DONE here —
@@ -199,6 +254,13 @@ class CohortStreamer:
             "hidden_seconds": round(hidden, 6),
             "overlap_ratio": round(hidden / dt, 4) if dt > 0 else 0.0,
         }
+        if any(idx is not None for idx in idx_list):
+            # Sampled cohorts: name the sampler and drain the pending
+            # cohort-replay seconds into this dispatch's record (the
+            # host cost the phase table's ``sample`` phase carries).
+            stats["sampler"] = self._sampler
+            stats["sample_ms"] = round(self._sample_pending * 1e3, 3)
+            self._sample_pending = 0.0
         return arrays, stats
 
     def upload_full(self):

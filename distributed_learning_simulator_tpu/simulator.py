@@ -208,8 +208,9 @@ def _assert_residency_feasible(config, global_params, n_clients: int,
                 f"param-sized state buffer(s) x {param_bytes / 2**20:.0f} "
                 f"MB = {total / 2**30:.1f} GB, over the "
                 f"~{budget / 2**30:.1f} GB device budget. Lower "
-                "participation_fraction (the cohort) or use more "
-                "mesh_devices with client_residency='resident'."
+                "participation_fraction (the cohort) or raise "
+                "mesh_devices (streamed residency shards the cohort "
+                "slice over the mesh)."
             )
         return
     total = data_bytes + factor * n_clients * param_bytes
@@ -898,16 +899,35 @@ def run_simulation(
     streamer = None
     startup_stream = {"rec": None}  # stream_full's one-shot upload record
     eval_batches = tuple(jnp.asarray(a) for a in eval_batches_np)
+    if config.mesh_devices and config.mesh_devices > 1:
+        mesh = make_mesh(config.mesh_devices)
+        # The DEVICE-resident client-axis length must split evenly over
+        # the mesh: the whole population when resident (or full-cohort
+        # streamed — the startup upload IS population-shaped), but only
+        # the sampled COHORT under streamed sampling, where the cohort
+        # slice is the array that carries PartitionSpec("clients").
+        shard_len = cohort_n if stream_sampled else n_clients
+        if shard_len % config.mesh_devices != 0:
+            what = (
+                "cohort size (participation_fraction x worker_number)"
+                if stream_sampled else "worker_number"
+            )
+            raise ValueError(
+                f"{what} ({shard_len}) must be a multiple of "
+                f"mesh_devices ({config.mesh_devices})"
+            )
     if streamed:
         # Host shard store owns the full-N arrays (data/residency.py);
-        # the streamer owns their device side (parallel/streaming.py).
-        # config.validate() already refused mesh/multihost + threaded.
+        # the streamer owns their device side (parallel/streaming.py) —
+        # under a mesh it uploads each cohort slice directly into the
+        # client-axis PartitionSpec layout. config.validate() already
+        # refused multihost + threaded.
         store = HostShardStore(
             client_data.x, client_data.y, client_data.mask,
             client_data.sizes,
             state=client_state if stream_sampled else None,
         )
-        streamer = CohortStreamer(store, algorithm, n_clients)
+        streamer = CohortStreamer(store, algorithm, n_clients, mesh=mesh)
         if stream_full:
             (cx, cy, cmask, sizes, _full_idx), startup_stream["rec"] = (
                 streamer.upload_full()
@@ -935,14 +955,15 @@ def run_simulation(
             jnp.asarray(client_data.mask),
         )
         sizes = jnp.asarray(client_data.sizes)
-    if config.mesh_devices and config.mesh_devices > 1:
-        mesh = make_mesh(config.mesh_devices)
-        if n_clients % config.mesh_devices != 0:
-            raise ValueError(
-                f"worker_number ({n_clients}) must be a multiple of "
-                f"mesh_devices ({config.mesh_devices})"
-            )
-        data_arrays = shard_client_data(data_arrays, mesh)
+    if mesh is not None:
+        if not streamed:
+            data_arrays = shard_client_data(data_arrays, mesh)
+        # stream_full's population arrays were already uploaded sharded
+        # by the streamer; stream_sampled has no full-N device arrays.
+        # Persistent client state (resident or full-cohort streamed) is
+        # client-axis sharded like the data; stream_sampled's state is
+        # None here (the host store owns it — the per-round cohort
+        # gather is sharded at dispatch time in the round loop).
         client_state = shard_client_data(client_state, mesh)
         global_params = replicate(global_params, mesh)
         if server_state is not None:
@@ -1720,7 +1741,13 @@ def run_simulation(
                             ):
                                 idx_list, hk_after = stream_next[1:]
                             else:
-                                idx_list, hk_after = _stream_plan(key, k)
+                                # First dispatch / resume: the k draws
+                                # get their own `sample` phase window.
+                                with phase_timer.phase(
+                                        last_idx, "sample"):
+                                    idx_list, hk_after = _stream_plan(
+                                        key, k
+                                    )
                             (sx, sy, sm, ssz, sidx), stream_rec = (
                                 streamer.acquire(idx_list, stack=True)
                             )
@@ -1750,7 +1777,18 @@ def run_simulation(
                                 stream_next = None
                                 if nxt < config.round and not preempt["flag"]:
                                     k2 = _dispatch_len(nxt)
+                                    # The k2 draws overlap this
+                                    # dispatch's compute; carve their
+                                    # host cost out of client_step into
+                                    # the `sample` phase (K=1 rationale
+                                    # above).
+                                    _t_s = time.perf_counter()
                                     idx2, hk2 = _stream_plan(hk_after, k2)
+                                    phase_timer.carve(
+                                        last_idx, "sample",
+                                        time.perf_counter() - _t_s,
+                                        "client_step",
+                                    )
                                     stream_next = (nxt, idx2, hk2)
                                     streamer.prefetch(idx2, stack=True)
                                 _ph.fence((global_params, metrics_k))
@@ -1862,11 +1900,17 @@ def run_simulation(
                             # gathers from the host store (post the
                             # previous round's writeback) and scatters
                             # back after this dispatch.
-                            idx_np = (
-                                stream_next_idx
-                                if stream_next_idx is not None
-                                else streamer.cohort_for(round_key)
-                            )
+                            if stream_next_idx is not None:
+                                idx_np = stream_next_idx
+                            else:
+                                # First round / resume: the draw is not
+                                # hidden behind a prior dispatch — its
+                                # own `sample` phase window.
+                                with phase_timer.phase(
+                                        round_idx, "sample"):
+                                    idx_np = streamer.cohort_for(
+                                        round_key
+                                    )
                             stream_next_idx = None
                             (sx, sy, sm, ssz, sidx), stream_rec = (
                                 streamer.acquire([idx_np])
@@ -1880,6 +1924,12 @@ def run_simulation(
                                         store, idx_np
                                     )
                                 )
+                                if mesh is not None:
+                                    # Cohort state joins the cohort
+                                    # slice's client-axis layout.
+                                    state_k = shard_client_data(
+                                        state_k, mesh
+                                    )
                             with phase_timer.phase(
                                     round_idx, "client_step") as _ph:
                                 new_global, new_state_k, aux = round_jit(
@@ -1889,13 +1939,23 @@ def run_simulation(
                                 )
                                 # Prefetch the next round's cohort while
                                 # this dispatch computes (the upload runs
-                                # on the streamer's worker thread).
+                                # on the streamer's worker thread). The
+                                # draw deliberately overlaps device
+                                # compute; its host cost is carved out
+                                # of this client_step window into the
+                                # `sample` phase so the ~1 s exact
+                                # replay at N=1e6 stays visible.
                                 if round_idx + 1 < config.round and not (
                                     preempt["flag"]
                                 ):
                                     _, _nxt_rk = jax.random.split(key)
                                     stream_next_idx = streamer.cohort_for(
                                         _nxt_rk
+                                    )
+                                    phase_timer.carve(
+                                        round_idx, "sample",
+                                        streamer.last_sample_seconds,
+                                        "client_step",
                                     )
                                     streamer.prefetch([stream_next_idx])
                                 _ph.fence((new_global, aux))
@@ -2124,6 +2184,16 @@ def run_simulation(
         ),
         "stream_d2h_bytes": (
             streamer.totals["d2h_bytes"] if streamer is not None else None
+        ),
+        # Cohort-draw replay cost (ops/sampling.py samplers): run-total
+        # host seconds spent re-deriving cohorts from the round-key
+        # chain — the `sample` phase's run total, the number the
+        # participation_sampler knob exists to shrink. None when
+        # resident (no host replay happens).
+        "participation_sampler": config.participation_sampler,
+        "stream_sample_seconds": (
+            streamer.totals["sample_seconds"]
+            if streamer is not None else None
         ),
         # Predictive cost model (telemetry/costmodel.py): the schema-v6
         # costmodel sub-object the run's last record carried — None when

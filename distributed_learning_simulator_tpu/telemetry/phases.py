@@ -4,8 +4,11 @@ A round's wall-clock splits into: ``client_step`` (the fused round
 program's dispatch — local training AND the in-program aggregation; XLA
 fuses them, so they are one phase by construction), ``aggregate`` (the
 server-optimizer post-step, when configured), ``eval``, ``host_sync``
-(the deferred device->host metric fetch), and ``post_round`` (host-side
-algorithm work, e.g. Shapley scoring).
+(the deferred device->host metric fetch), ``post_round`` (host-side
+algorithm work, e.g. Shapley scoring), and — under streamed residency
+with a sampled cohort — ``sample`` (the host-side cohort-draw replay,
+``parallel/streaming.CohortStreamer.cohort_for``; carved out of the
+``client_step`` window it overlaps via :meth:`PhaseTimer.carve`).
 
 Two fidelity modes, selected by ``config.telemetry_level``:
 
@@ -78,6 +81,24 @@ class PhaseTimer:
         round recorded nothing)."""
         return self._acc.pop(round_idx, {})
 
+    def carve(self, round_idx: int, name: str, seconds: float,
+              source: str) -> None:
+        """Re-attribute ``seconds`` of host work from the OPEN ``source``
+        phase window to its own named phase.
+
+        Used for the streamed cohort-draw replay (``sample``): the draw
+        for the next dispatch deliberately runs after the current
+        dispatch launches — inside the ``client_step`` region, so it
+        overlaps device compute — but its host cost (the ~1 s exact
+        replay at N=1e6) must be visible in the phase table, not hidden
+        in ``client_step``. The negative accumulation nets out when the
+        enclosing context exits and adds its full wall; phases stay
+        disjoint.
+        """
+        acc = self._acc.setdefault(round_idx, {})
+        acc[name] = acc.get(name, 0.0) + seconds
+        acc[source] = acc.get(source, 0.0) - seconds
+
 
 class NullPhaseTimer:
     """``telemetry_level='off'``: same API, no clocks, no records."""
@@ -89,6 +110,10 @@ class NullPhaseTimer:
         yield _FenceBox()
 
     def take(self, round_idx: int) -> None:
+        return None
+
+    def carve(self, round_idx: int, name: str, seconds: float,
+              source: str) -> None:
         return None
 
 

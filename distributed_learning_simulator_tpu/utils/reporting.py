@@ -169,6 +169,11 @@ def config_hash(config) -> str:
             d.pop(k, None)
     if not d.get("gtg_cross_round_memo", False):
         d.pop("gtg_cross_round_memo", None)
+    if (d.get("participation_sampler") or "exact").lower() == "exact":
+        # 'exact' IS the pre-feature draw (ops/sampling.py), so
+        # pre-feature configs keep their pre-feature hash; 'hashed'
+        # changes the drawn cohorts and lands in the hash.
+        d.pop("participation_sampler", None)
     blob = json.dumps(d, sort_keys=True, default=repr)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 

@@ -40,7 +40,13 @@ rounds over the sync counterfactual): ``--async-speedup-threshold``
 is an absolute floor, default 1.0. And the ``stream`` leg's prefetch
 ``overlap_ratio`` (fraction of host->HBM upload time hidden behind
 compute at the largest swept population, client_residency='streamed'):
-``--stream-overlap-threshold`` is an absolute floor, default 0.5. The
+``--stream-overlap-threshold`` is an absolute floor, default 0.5 —
+and the same leg's ``cohort_rate`` (steady cohort·rounds/s at that
+population under the fastest-supported ``participation_sampler``)
+gets ``--stream-cohort-rate-threshold`` as an absolute floor, default
+900: the O(cohort) hashed sampler retired the exact replay's ~1 s/round
+host-bound ceiling (328 c·r/s at N=1e6, r07), and the gate keeps the
+million-client leg model-bound. The
 ``valuation`` leg's ``audit_spearman`` (streaming client-valuation
 vector vs cumulative exact-GTG audit SVs on the graded-quality
 differential config, telemetry/valuation.py) gets
@@ -244,6 +250,35 @@ def stream_overlap_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def stream_cohort_rate_gate(record: dict, threshold: float) -> dict | None:
+    """In-record streamed-throughput gate: bench.py's ``stream`` leg
+    records, at its largest swept population under the
+    fastest-supported ``participation_sampler`` (hashed when swept —
+    ops/sampling.py), the steady cohort training rate
+    (``cohort_rate``, cohort·rounds/s). A rate below ``threshold``
+    means the million-client stream leg went host-bound again — the
+    regression the O(cohort) sampler exists to prevent (the exact
+    replay's O(N log N) draw measured ~1 s/round at N=1e6,
+    docs/PERFORMANCE.md § Streamed client state). Judged ABSOLUTELY
+    like the other in-record gates (an absolute floor in the record's
+    own units, the PR 4/5/7 precedent). None when the leg is absent or
+    the floor holds."""
+    rate = get_path(record, "stream.cohort_rate")
+    if rate is None or rate >= threshold:
+        return None
+    return {
+        "metric": "stream.cohort_rate",
+        "description": (
+            "steady cohort·rounds/s of the streamed-residency leg at "
+            "its largest swept population, fastest-supported sampler "
+            "(the million-client leg must stay model-bound, not "
+            "host-bound on the cohort draw)"
+        ),
+        "old": threshold, "new": rate,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def valuation_corr_gate(record: dict, threshold: float) -> dict | None:
     """In-record valuation-fidelity gate: bench.py's ``valuation`` leg
     measures, on the small-N graded-quality differential config, the
@@ -343,6 +378,14 @@ def main(argv: list[str] | None = None) -> int:
                          "record's stream leg at its largest population "
                          "(default 0.5 — at least half the host->HBM "
                          "upload time must hide behind compute)")
+    ap.add_argument("--stream-cohort-rate-threshold", type=float,
+                    default=900.0,
+                    help="min tolerated cohort*rounds/s in the NEW "
+                         "record's stream leg at its largest population, "
+                         "fastest-supported sampler (default 900 — ~3x "
+                         "the r07 host-bound 328 c*r/s N=1e6 CPU "
+                         "baseline the hashed sampler retired; "
+                         "docs/PERFORMANCE.md § Streamed client state)")
     ap.add_argument("--valuation-corr-threshold", type=float, default=0.8,
                     help="min tolerated streaming-valuation vs GTG-audit "
                          "Spearman correlation in the NEW record's "
@@ -382,6 +425,7 @@ def main(argv: list[str] | None = None) -> int:
         batch_amortization_gate(new, args.batch_amortization_threshold),
         async_speedup_gate(new, args.async_speedup_threshold),
         stream_overlap_gate(new, args.stream_overlap_threshold),
+        stream_cohort_rate_gate(new, args.stream_cohort_rate_threshold),
         valuation_corr_gate(new, args.valuation_corr_threshold),
     ):
         if gate is not None:

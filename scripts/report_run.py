@@ -378,6 +378,15 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
                 sum(s.get("d2h_seconds", 0.0) for s in streams), 4
             ),
         }
+        # Cohort-draw replay accounting (participation_sampler,
+        # ops/sampling.py): the sampler name + run-total sample time —
+        # the host cost the `sample` phase row carries per round.
+        samplers = {s["sampler"] for s in streams if s.get("sampler")}
+        if samplers:
+            summary["stream"]["sampler"] = "/".join(sorted(samplers))
+            summary["stream"]["sample_ms"] = round(
+                sum(s.get("sample_ms", 0.0) for s in streams), 3
+            )
 
     health = summarize_client_health(records)
     if health is not None:
@@ -477,6 +486,12 @@ def render_summary(summary: dict) -> list[str]:
                 if s["d2h_bytes"] else ""
             )
         )
+        if s.get("sampler"):
+            lines.append(
+                f"  cohort sampler: {s['sampler']} "
+                f"({s['sample_ms']:.1f} ms total replay — the `sample` "
+                "phase row)"
+            )
     if "compiles" in summary:
         c = summary["compiles"]
         lines.append(

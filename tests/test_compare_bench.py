@@ -256,6 +256,48 @@ def test_stream_overlap_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_stream_cohort_rate_not_relatively_tracked(cb):
+    """The streamed cohort rate is gated by its own absolute in-record
+    floor, never as a relative TRACKED metric (the PR 4/5/7 precedent
+    for in-record gates)."""
+    old = _record(stream={"cohort_rate": 19000.0})
+    new = _record(stream={"cohort_rate": 15000.0})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "stream" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_stream_cohort_rate_self_gate(cb, tmp_path):
+    """In-record absolute floor: the largest-population streamed leg
+    going host-bound again (cohort rate under the floor) gates on the
+    NEW record alone — the O(cohort) sampler's regression signal."""
+    assert cb.stream_cohort_rate_gate(_record(), 900.0) is None  # absent
+    ok = _record(stream={"cohort_rate": 18000.0, "overlap_ratio": 0.9})
+    assert cb.stream_cohort_rate_gate(ok, 900.0) is None
+    bad = _record(stream={"cohort_rate": 330.0, "overlap_ratio": 0.9})
+    entry = cb.stream_cohort_rate_gate(bad, 900.0)
+    assert entry and entry["new"] == 330.0 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "stream.cohort_rate" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--stream-cohort-rate-threshold", "100"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_valuation_corr_not_relatively_tracked(cb):
     """The estimator-fidelity correlation sits near a fixed operating
     point (~0.85-0.9) — like every other in-record ratio it must never

@@ -162,6 +162,17 @@ def test_v5_record_validates():
         "hidden_seconds": 0.0, "overlap_ratio": 0.0,
         "dispatch_rounds": 4,
     }))
+    # Sampled-cohort uploads name the sampler + the cohort-draw replay
+    # cost (participation_sampler, ops/sampling.py) — still v5.
+    for sampler in ("exact", "hashed"):
+        validate(build_round_record(_base(), None, None, None, {
+            **_stream(), "sampler": sampler, "sample_ms": 1203.4,
+        }))
+    # An unknown sampler name is a schema break, not a silent extension.
+    with pytest.raises(jsonschema.ValidationError):
+        validate(build_round_record(_base(), None, None, None, {
+            **_stream(), "sampler": "quantum", "sample_ms": 0.1,
+        }))
 
 
 def _costmodel() -> dict:

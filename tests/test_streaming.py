@@ -65,9 +65,14 @@ def test_config_validation():
         ExperimentConfig(
             client_residency="streamed", execution_mode="threaded"
         ).validate()
-    with pytest.raises(ValueError, match="mesh"):
+    # Single-host mesh sharding COMPOSES with streamed residency (the
+    # streamer uploads straight into the client-axis PartitionSpec
+    # layout); multi-host still refuses naming the cause (the host
+    # shard store is single-process).
+    ExperimentConfig(client_residency="streamed", mesh_devices=2).validate()
+    with pytest.raises(ValueError, match="multihost"):
         ExperimentConfig(
-            client_residency="streamed", mesh_devices=2
+            client_residency="streamed", multihost=True
         ).validate()
     ExperimentConfig(client_residency="streamed").validate()
 
@@ -348,6 +353,134 @@ def test_streamed_checkpoint_resume_mid_run(tiny_config, tmp_path):
     assert stitched == golden
 
 
+# ------------------------------------------- mesh composition (ISSUE 10)
+#
+# Streamed residency composes with single-host mesh sharding: the
+# streamer uploads each cohort slice directly into the client-axis
+# PartitionSpec layout (per-shard host->device transfers addressed by
+# the mesh's client-axis ownership; parallel/streaming.py). The pins:
+# cohort draws (the round-key replay) are BIT-identical across every
+# residency x mesh combination, and for a FIXED mesh the streamed run
+# equals the resident run — streaming is a residency detail, never a
+# semantics change. Mesh-vs-single-device metric equality is to
+# reduction-order tolerance, the same contract the resident mesh tests
+# (test_multichip.py) have always pinned: sharding the f32 client-axis
+# reduction reorders the sum.
+
+
+def _mesh_series(cfg, *keys, **overrides):
+    res = _run(cfg, **overrides)
+    return {k: [h.get(k) for h in res["history"]] for k in keys}
+
+
+def test_streamed_mesh_matches_resident_mesh_fedavg(tiny_config):
+    """FedAvg sampled cohort, same 4-device mesh: streamed (uploaded
+    pre-gathered sharded slices) vs resident (in-program gather from
+    the sharded population) — bit-equal cohort draws, metrics equal to
+    reduction-order tolerance."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=16, round=3, participation_fraction=0.5,
+        mesh_devices=4,
+    )
+    resident = _mesh_series(cfg, *_BIT_KEYS)
+    streamed = _mesh_series(cfg, *_BIT_KEYS, client_residency="streamed")
+    assert streamed["cohort_hash"] == resident["cohort_hash"]
+    assert None not in streamed["cohort_hash"]
+    np.testing.assert_allclose(
+        streamed["test_loss"], resident["test_loss"], atol=1e-4
+    )
+    np.testing.assert_allclose(
+        streamed["test_accuracy"], resident["test_accuracy"], atol=1e-3
+    )
+
+
+def test_streamed_mesh_matches_streamed_single_device(tiny_config):
+    """Same streamed program, mesh vs one device: cohort draws
+    bit-equal (the host replay never touches the mesh), metrics to the
+    mesh reduction-order tolerance — and the hashed O(cohort) sampler
+    composes identically."""
+    for sampler in ("exact", "hashed"):
+        cfg = dataclasses.replace(
+            tiny_config, worker_number=16, round=3,
+            participation_fraction=0.5, client_residency="streamed",
+            participation_sampler=sampler,
+        )
+        single = _mesh_series(cfg, *_BIT_KEYS)
+        mesh = _mesh_series(cfg, *_BIT_KEYS, mesh_devices=4)
+        assert mesh["cohort_hash"] == single["cohort_hash"], sampler
+        np.testing.assert_allclose(
+            mesh["test_loss"], single["test_loss"], atol=1e-4
+        )
+
+
+def test_streamed_mesh_sign_sgd_full_cohort(tiny_config):
+    """sign_SGD (full-cohort streamed regime: one startup upload,
+    population-shaped and mesh-sharded): bit-identical to the resident
+    mesh run — the discrete per-step vote quantizes away reduction
+    noise."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="sign_SGD", learning_rate=0.01,
+        momentum=0.9, worker_number=16, round=2, mesh_devices=4,
+    )
+    keys = ("test_accuracy", "test_loss", "mean_client_loss")
+    assert _mesh_series(cfg, *keys) == _mesh_series(
+        cfg, *keys, client_residency="streamed"
+    )
+
+
+def test_streamed_mesh_fed_quant(tiny_config):
+    """fed_quant, same mesh: bit-equal cohorts; the stochastic
+    quantizer DISCRETIZES reduction-order ulps into visible (but
+    bounded) metric deltas, so the tolerance is looser than plain
+    fed's."""
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="fed_quant", worker_number=16,
+        round=3, participation_fraction=0.5, mesh_devices=4,
+        client_eval=False,
+    )
+    resident = _mesh_series(cfg, *_BIT_KEYS)
+    streamed = _mesh_series(cfg, *_BIT_KEYS, client_residency="streamed")
+    assert streamed["cohort_hash"] == resident["cohort_hash"]
+    np.testing.assert_allclose(
+        streamed["test_loss"], resident["test_loss"], atol=5e-3
+    )
+
+
+def test_streamed_mesh_batched_and_persistent_state(tiny_config):
+    """The remaining composition axes on one mesh: K>1 batched scan
+    dispatches (stacked [K, cohort, ...] sharded uploads) and the
+    persistent-state writeback path (sharded cohort state gathered
+    from and scattered back to the host store)."""
+    base = dataclasses.replace(
+        tiny_config, worker_number=16, round=4, participation_fraction=0.5,
+        mesh_devices=4, client_residency="streamed",
+    )
+    for overrides in (
+        {"rounds_per_dispatch": 2},
+        {"reset_client_optimizer": False},
+    ):
+        cfg = dataclasses.replace(base, **overrides)
+        streamed = _mesh_series(cfg, *_BIT_KEYS)
+        resident = _mesh_series(cfg, *_BIT_KEYS,
+                                client_residency="resident")
+        assert streamed["cohort_hash"] == resident["cohort_hash"], overrides
+        np.testing.assert_allclose(
+            streamed["test_loss"], resident["test_loss"], atol=1e-4,
+        )
+
+
+def test_streamed_mesh_cohort_divisibility_refused(tiny_config):
+    """Unsupported combination still refuses naming the cause: the
+    COHORT (not the population) is the device-resident client axis
+    under streamed sampling, so it must divide the mesh."""
+    cfg = dataclasses.replace(
+        tiny_config, worker_number=16, round=2, participation_fraction=0.5,
+        client_residency="streamed", mesh_devices=3,
+    )
+    with pytest.raises(ValueError, match="cohort size"):
+        _run(cfg)
+
+
 # ------------------------------------------------------ stream telemetry
 
 
@@ -387,6 +520,46 @@ def test_stream_records_and_result_fields(tiny_config, tmp_path):
     assert resident["stream_overlap_ratio"] is None
     for rec in _read_metrics(tmp_path / "r"):
         assert "stream" not in rec and "schema_version" not in rec
+
+
+def test_sample_phase_and_stream_sampler_fields(tiny_config, tmp_path):
+    """The cohort-draw replay cost is visible end to end: `sample` in
+    the telemetry phase table (carved out of the client_step window it
+    overlaps), sampler/sample_ms in the schema-v5 stream record, and
+    the run total in the result dict — for both sampler modes."""
+    jsonschema = pytest.importorskip("jsonschema")
+    schema = json.load(open(
+        os.path.join(os.path.dirname(__file__), "data",
+                     "metrics_record.schema.json")
+    ))
+    for sampler in ("exact", "hashed"):
+        root = tmp_path / sampler
+        res = run_simulation(dataclasses.replace(
+            tiny_config, worker_number=8, round=3,
+            participation_fraction=0.5, client_residency="streamed",
+            participation_sampler=sampler, telemetry_level="basic",
+            log_root=str(root),
+        ))
+        assert res["participation_sampler"] == sampler
+        assert res["stream_sample_seconds"] > 0
+        records = _read_metrics(root)
+        for rec in records:
+            jsonschema.validate(rec, schema)
+            assert rec["stream"]["sampler"] == sampler
+            assert rec["stream"]["sample_ms"] >= 0
+        # Every round with a prefetched next cohort records the draw in
+        # its own `sample` phase (the final round draws nothing).
+        phases = [rec["telemetry"]["phase_seconds"] for rec in records]
+        assert all("sample" in p for p in phases[:-1])
+        # Full-cohort streamed (no draw): no sampler fields, no phase.
+        res_full = run_simulation(dataclasses.replace(
+            tiny_config, worker_number=8, round=2,
+            client_residency="streamed", participation_sampler=sampler,
+            telemetry_level="basic", log_root=str(tmp_path / ("f" + sampler)),
+        ))
+        assert res_full["stream_sample_seconds"] == 0.0
+        for rec in _read_metrics(tmp_path / ("f" + sampler)):
+            assert "sampler" not in rec.get("stream", {})
 
 
 def test_report_run_renders_transfer_row(tiny_config, tmp_path):

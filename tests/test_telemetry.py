@@ -199,7 +199,10 @@ def test_build_round_record_v2_layout():
     assert v4["schema_version"] == 4
     assert v4["async"] == {"on_time": 4}
     v5 = build_round_record(base, tel, None, None, {"h2d_bytes": 8})
-    assert v5["schema_version"] == METRICS_SCHEMA_VERSION == 5
+    # Lowest-version stamping: a stream-carrying record stays v5 even
+    # though the CURRENT top version has moved on (v6 costmodel, v7
+    # valuation — their own tests pin those stamps).
+    assert v5["schema_version"] == 5 <= METRICS_SCHEMA_VERSION
     assert v5["stream"] == {"h2d_bytes": 8}
 
 

@@ -218,6 +218,9 @@ def test_config_hash_off_gate_invariance():
     for k in _NON_PROGRAM_FIELDS + (
         "client_valuation", "valuation_decay", "valuation_audit_every",
         "valuation_audit_permutations", "gtg_cross_round_memo",
+        # Off-gated at its 'exact' default like the valuation knobs
+        # (ISSUE 10, ops/sampling.py).
+        "participation_sampler",
     ):
         d.pop(k, None)
     pre_feature = hashlib.sha256(
