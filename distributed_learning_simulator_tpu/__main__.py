@@ -1,6 +1,8 @@
 """``python -m distributed_learning_simulator_tpu`` — same CLI as
 ``python -m distributed_learning_simulator_tpu.simulator`` (the reference's
-``python3 simulator.py`` entry, reference simulator.sh:1)."""
+``python3 simulator.py`` entry, reference simulator.sh:1). With
+``--sweep_seeds`` / ``--sweep_points`` set, the process runs a
+multi-experiment sweep (sweep/engine.py) instead of one simulation."""
 
 from distributed_learning_simulator_tpu.simulator import main
 

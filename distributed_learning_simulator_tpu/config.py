@@ -30,6 +30,16 @@ CLIENT_STATS_LEVELS = ("off", "on")
 # TELEMETRY_LEVELS — ops.sampling imports jax.
 PARTICIPATION_SAMPLERS = ("exact", "hashed")
 
+# Valid sweep_strategy values (sweep/spec.py re-exports this). Same
+# import-light placement rationale as TELEMETRY_LEVELS — the sweep
+# engine imports jax.
+SWEEP_STRATEGIES = ("auto", "vmapped", "scheduled")
+
+# Registry names of the Shapley servers — the one copy config.validate()
+# and sweep/spec.py both refuse sweeps against (their post_round drives
+# data-dependent subset evaluation no shared program can serve).
+SHAPLEY_ALGORITHMS = ("multiround_shapley_value", "GTG_shapley_value")
+
 
 @dataclass
 class ExperimentConfig:
@@ -484,6 +494,35 @@ class ExperimentConfig:
     # anchored on — the hardware this run's measured round time comes
     # from; model_error_ratio is predicted-vs-measured on this entry.
     cost_model_topology: str = "v5e-1"
+    # --- multi-experiment sweep (sweep/; docs/PERFORMANCE.md § Sweep) ------
+    # Comma-separated seed list: run one experiment per seed as a FLEET
+    # sharing this config's dataset/partition (data seed stays this
+    # config's `seed`; each point's seed drives model init + the training
+    # RNG chain). Where every point agrees on the program-defining knobs
+    # (seed/learning_rate may vary), the fleet runs as ONE vmapped jitted
+    # program — compile paid once, each point's history bit-identical to
+    # a solo run with that seed on the shared data. None (default) = no
+    # sweep; `python -m distributed_learning_simulator_tpu` dispatches to
+    # sweep.run_sweep when set.
+    sweep_seeds: str | None = None
+    # JSON list of per-point config overrides, e.g.
+    # '[{"learning_rate": 0.05}, {"learning_rate": 0.1}]'. Combined with
+    # sweep_seeds, every override runs at every seed (the grid).
+    # Heterogeneous overrides (program-defining knobs) route through the
+    # compile-cache-aware scheduler: points group by config_hash and run
+    # sequentially through one warm program per (seed-normalized)
+    # program class, with per-point compile reuse recorded.
+    sweep_points: str | None = None
+    # "auto" (default): vmapped fleet when every point is
+    # fleet-compatible, else the scheduler. "vmapped"/"scheduled" force
+    # a strategy ("vmapped" refuses with the blocking feature named).
+    sweep_strategy: str = "auto"
+    # Sweep-level checkpointing: every completed point persists its
+    # result + schema-v8 records here; an interrupted sweep resumes with
+    # sweep_resume=True, re-running only the missing points (points are
+    # RNG-independent, so the stitched sweep is bit-identical).
+    sweep_dir: str | None = None
+    sweep_resume: bool = False
     # Persistent XLA compilation cache directory: the round program's
     # ~20-45s first compile is skipped on any later run with the same
     # shapes (including across processes). Disable with None, or from the
@@ -530,6 +569,51 @@ class ExperimentConfig:
             )
         if self.compilation_cache_dir in ("", "none", "None"):
             self.compilation_cache_dir = None
+        if self.sweep_strategy not in SWEEP_STRATEGIES:
+            raise ValueError(
+                f"unknown sweep_strategy {self.sweep_strategy!r}; known: "
+                + ", ".join(SWEEP_STRATEGIES)
+            )
+        if self.sweep_resume and not self.sweep_dir:
+            raise ValueError(
+                "sweep_resume=True needs sweep_dir (where the completed "
+                "points were persisted)"
+            )
+        if self.sweep_seeds or self.sweep_points:
+            # Sweep-wide refusals (the one authoritative copy; sweep/
+            # spec.py re-checks per point because overrides can
+            # introduce any of these).
+            if self.execution_mode.lower() == "threaded":
+                raise ValueError(
+                    "execution_mode='threaded' does not support sweeps: "
+                    "the thread-per-client oracle owns one OS thread per "
+                    "client per experiment and shares no compiled "
+                    "program; run threaded points as solo runs"
+                )
+            if self.distributed_algorithm in SHAPLEY_ALGORITHMS:
+                raise ValueError(
+                    f"algorithm {self.distributed_algorithm!r} does not "
+                    "support sweeps: its post_round drives data-dependent "
+                    "subset evaluation that must observe every round "
+                    "synchronously; run Shapley configs as solo runs"
+                )
+            if (
+                self.client_residency.lower() == "streamed"
+                and self.rounds_per_dispatch > 1
+            ):
+                raise ValueError(
+                    "client_residency='streamed' with rounds_per_dispatch"
+                    " > 1 does not compose with sweeps: the scheduler "
+                    "cannot host-replay K stacked cohort plans across "
+                    "points sharing one streamer; set "
+                    "rounds_per_dispatch=1 or client_residency='resident'"
+                )
+            if self.multihost:
+                raise ValueError(
+                    "sweeps do not compose with multihost: every process "
+                    "would re-run the whole point list; shard the sweep "
+                    "across hosts by splitting the point list instead"
+                )
         if self.cost_model_trace_rounds < 1:
             raise ValueError("cost_model_trace_rounds must be >= 1")
         from distributed_learning_simulator_tpu.telemetry.topologies import (
@@ -952,7 +1036,8 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
         elif f.name in ("round_trunc_threshold", "checkpoint_dir", "data_dir",
                         "profile_dir", "cost_model_trace",
                         "client_chunk_size", "max_shard_size",
-                        "coordinator_address"):
+                        "coordinator_address", "sweep_seeds",
+                        "sweep_points", "sweep_dir"):
             typ = {
                 "round_trunc_threshold": float,
                 "client_chunk_size": int,

@@ -526,6 +526,65 @@ def make_streamed_batched_round_fn(round_fn, server_update_fn, eval_fn,
     return batched
 
 
+def make_experiment_round_fn(round_fn, lr_schedule: bool):
+    """vmap a resident-convention round fn over a leading EXPERIMENT axis
+    (the sweep engine's vmapped fleet, sweep/engine.py).
+
+    Each experiment carries its own global params and RNG key chain
+    (stacked ``[E, ...]`` / ``[E]`` operands); the client data, masks and
+    sizes broadcast (``in_axes=None`` — one shared partition, the sweep
+    data contract). The per-experiment body replays the solo host loop's
+    round sequence exactly — ``key, round_key = jax.random.split(key)``
+    then the round program — so experiment ``i``'s outputs are
+    bit-identical to a solo run whose loop holds that key
+    (tests/test_sweep.py pins it). ``jax.random.split`` is elementwise on
+    the key data, so the vmapped split equals the solo eager split
+    bit-for-bit; everything downstream is the same XLA ops with one more
+    batch dimension.
+
+    ``lr_schedule`` (trace-time, the PR 5 operand discipline): when True
+    the returned function takes a ``[E]`` f32 vector — per-experiment lr
+    factor x the round's schedule factor — consumed with ``in_axes=0``;
+    when False the round fn is called WITHOUT the operand so the
+    constant default constant-folds exactly like the solo program.
+
+    Returns ``fleet(params_E, keys_E, cx, cy, cmask, sizes[, lr_vec]) ->
+    (new_params_E, new_keys_E, aux_E)``. Per-client state is not carried
+    (the sweep spec refuses persistent client state for fleets — E full
+    per-client stacks would defeat the memory envelope).
+    """
+
+    def one(params, key, cx, cy, cmask, sizes, lr=None):
+        key, round_key = jax.random.split(key)
+        args = (params, None, cx, cy, cmask, sizes, round_key)
+        if lr is not None:
+            args = args + (lr,)
+        new_params, _state, aux = round_fn(*args)
+        return new_params, key, aux
+
+    data_axes = (None, None, None, None)
+
+    def fleet(params_e, keys_e, cx, cy, cmask, sizes, lr_vec=None):
+        if lr_schedule:
+            return jax.vmap(one, in_axes=(0, 0) + data_axes + (0,))(
+                params_e, keys_e, cx, cy, cmask, sizes, lr_vec
+            )
+        return jax.vmap(one, in_axes=(0, 0) + data_axes)(
+            params_e, keys_e, cx, cy, cmask, sizes
+        )
+
+    return fleet
+
+
+def make_experiment_eval_fn(eval_fn, n_eval_operands: int):
+    """vmap a server-eval fn over the experiment axis: stacked params,
+    broadcast test batches — the fleet's one-dispatch evaluation of all
+    E experiment models (pairs with :func:`make_experiment_round_fn`;
+    kept a SEPARATE jitted program like the solo loop's ``evaluate``, so
+    the fleet's program structure mirrors the solo round/eval pair)."""
+    return jax.vmap(eval_fn, in_axes=(0,) + (None,) * n_eval_operands)
+
+
 def make_reshaper(sample_shape):
     """Batch preprocess for flattened eval storage: restore sample shape.
 

@@ -293,6 +293,47 @@ def lr_factors(config, start: int, k: int) -> np.ndarray:
     )
 
 
+def build_base_round_record(config, round_idx: int, metrics: dict,
+                            fetched_loss, fetched_tel: dict, extra: dict,
+                            round_seconds: float) -> dict:
+    """The v1-layout base of one round's metrics record — fields AND
+    insert order. The ONE copy shared by ``run_simulation``'s
+    emit_record and the sweep engine's lean/fleet loops
+    (sweep/engine.py), so a sweep point's records can never drift from
+    solo metrics.jsonl lines. ``extra`` is the algorithm's post_round
+    dict (non-scalar values filtered exactly as before);
+    ``round_seconds`` is the caller's wall attribution (between-round
+    wall solo; the amortized dispatch share in a fleet)."""
+    record = {
+        "round": round_idx,
+        "test_accuracy": metrics["accuracy"],
+        "test_loss": metrics["loss"],
+        "mean_client_loss": float(fetched_loss),
+        "round_seconds": round_seconds,
+        **{
+            k: v for k, v in extra.items()
+            if isinstance(v, (int, float, dict))
+        },
+    }
+    if config.lr_schedule.lower() != "constant":
+        record["lr_factor"] = _lr_factor(config, round_idx)
+    if "survivor_count" in fetched_tel:
+        record["survivor_count"] = int(fetched_tel["survivor_count"])
+    if "round_rejected" in fetched_tel:
+        record["round_rejected"] = bool(fetched_tel["round_rejected"])
+    if "participants" in fetched_tel:
+        # CRC of the sampled cohort: a compact per-round fingerprint
+        # that lets the resume-determinism tests assert the cohort
+        # sampling stream survives checkpoint/resume bit-exactly
+        # without bloating metrics.jsonl with index lists.
+        record["cohort_hash"] = zlib.crc32(
+            np.ascontiguousarray(
+                fetched_tel["participants"], dtype=np.int64
+            ).tobytes()
+        )
+    return record
+
+
 #: Per-round async-federation scalars the round program reports in aux
 #: (robustness/arrivals.py; the carried ``async_state`` itself is popped
 #: before any record building). Fetched inside the round's single metric
@@ -1157,46 +1198,24 @@ def run_simulation(
                 phase_round, "post_round"):
             extra = algorithm.post_round(ctx) or {}
         now = time.perf_counter()
-        record = {
-            "round": round_idx,
-            "test_accuracy": metrics["accuracy"],
-            "test_loss": metrics["loss"],
-            "mean_client_loss": float(fetched_loss),
-            # Wall time between successive round completions: covers train +
-            # eval + metric fetch + host post_round (Shapley time included —
-            # it IS per-round server work). Sums to total wall time (within
-            # a batched dispatch the dispatch's wall lands on its first
-            # round; later rounds record only their host-side tail).
-            "round_seconds": now - t_prev_done,
-            **{
-                k: v for k, v in extra.items()
-                if isinstance(v, (int, float, dict))
-            },
-        }
-        if config.lr_schedule.lower() != "constant":
-            record["lr_factor"] = _lr_factor(config, round_idx)
-        if "survivor_count" in fetched_tel:
-            record["survivor_count"] = int(fetched_tel["survivor_count"])
+        # Wall time between successive round completions: covers train +
+        # eval + metric fetch + host post_round (Shapley time included —
+        # it IS per-round server work). Sums to total wall time (within
+        # a batched dispatch the dispatch's wall lands on its first
+        # round; later rounds record only their host-side tail).
+        record = build_base_round_record(
+            config, round_idx, metrics, fetched_loss, fetched_tel, extra,
+            round_seconds=now - t_prev_done,
+        )
+        if "survivor_count" in record:
             telemetry["survivor_counts"].append(record["survivor_count"])
-        if "round_rejected" in fetched_tel:
-            record["round_rejected"] = bool(fetched_tel["round_rejected"])
-            if record["round_rejected"]:
-                telemetry["rounds_rejected"] += 1
-                logger.warning(
-                    "round %d REJECTED by quorum policy (survivors=%s, "
-                    "min_survivors=%d): previous global model retained",
-                    round_idx, record.get("survivor_count"),
-                    config.min_survivors,
-                )
-        if "participants" in fetched_tel:
-            # CRC of the sampled cohort: a compact per-round fingerprint
-            # that lets the resume-determinism tests assert the cohort
-            # sampling stream survives checkpoint/resume bit-exactly
-            # without bloating metrics.jsonl with index lists.
-            record["cohort_hash"] = zlib.crc32(
-                np.ascontiguousarray(
-                    fetched_tel["participants"], dtype=np.int64
-                ).tobytes()
+        if record.get("round_rejected"):
+            telemetry["rounds_rejected"] += 1
+            logger.warning(
+                "round %d REJECTED by quorum policy (survivors=%s, "
+                "min_survivors=%d): previous global model retained",
+                round_idx, record.get("survivor_count"),
+                config.min_survivors,
             )
         t_prev_done = now
         cs_rec = None
@@ -2222,10 +2241,30 @@ def run_simulation(
     }
 
 
+def run_sweep(config_or_spec, dataset=None, client_data=None):
+    """Multi-experiment front door (sweep/): run a fleet of experiments
+    — vmapped over an experiment axis where the points allow, scheduled
+    through config-hash-grouped warm programs where they don't. Thin
+    re-export so ``simulator`` stays the one entry module; the engine
+    lives in sweep/engine.py (imported lazily — solo runs never pay the
+    import)."""
+    from distributed_learning_simulator_tpu.sweep import (
+        run_sweep as _run_sweep,
+    )
+
+    return _run_sweep(config_or_spec, dataset=dataset,
+                      client_data=client_data)
+
+
 def main(argv: list[str] | None = None):
     from distributed_learning_simulator_tpu.config import get_config
+    from distributed_learning_simulator_tpu.sweep.spec import SweepSpec
 
     config = get_config(argv)
+    if SweepSpec.active(config):
+        # Sweep knobs set (sweep_seeds / sweep_points): the process runs
+        # a FLEET of experiments instead of one (sweep/engine.py).
+        return run_sweep(config)
     result = run_simulation(config)
     return result
 

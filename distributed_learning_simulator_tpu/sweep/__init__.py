@@ -1,0 +1,34 @@
+"""Multi-experiment sweep engine (ROADMAP item 1).
+
+``SweepSpec`` is the front door (validation, strategy resolution,
+refusals); ``run_sweep`` executes a fleet — vmapped over an experiment
+axis where the points allow, config-hash-scheduled through warm
+programs where they don't. ``SweepScheduler`` and ``lean_supported``
+are the reusable warm-program pieces (bench.py and
+scripts/measure_scaling.py route repeated runs through them so warmup
+is paid once and recorded explicitly).
+"""
+
+from distributed_learning_simulator_tpu.sweep.engine import (
+    EXPERIMENT_AXIS,
+    SweepScheduler,
+    lean_supported,
+    run_sweep,
+)
+from distributed_learning_simulator_tpu.sweep.spec import (
+    FLEET_AXES,
+    SWEEP_STRATEGIES,
+    SweepPoint,
+    SweepSpec,
+)
+
+__all__ = [
+    "EXPERIMENT_AXIS",
+    "FLEET_AXES",
+    "SWEEP_STRATEGIES",
+    "SweepPoint",
+    "SweepScheduler",
+    "SweepSpec",
+    "lean_supported",
+    "run_sweep",
+]

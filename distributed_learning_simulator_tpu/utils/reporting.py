@@ -31,16 +31,21 @@ import json
 # the ``valuation`` sub-object (the streaming per-client contribution
 # vector's fold inputs and top/bottom tables, and — on audit rounds —
 # the truncated-GTG cross-validation correlations;
-# telemetry/valuation.py). A record
+# telemetry/valuation.py). v8 adds the ``sweep`` sub-object (which
+# sweep point a record belongs to, the execution strategy, the point's
+# config-hash group, and whether its program was reused warm;
+# sweep/engine.py). A record
 # is stamped with the LOWEST version that describes it:
 # telemetry_level='off' keeps emitting v1 byte-for-byte,
 # client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
 # async_mode='off' keeps records at v3 or below, client_residency=
 # 'resident' keeps records at v4 or below, cost_model_trace=None
-# keeps records at v5 or below, and client_valuation='off' keeps
-# records at v6 or below — longitudinal tooling never sees a
+# keeps records at v5 or below, client_valuation='off' keeps
+# records at v6 or below, and solo (non-sweep) runs keep records at v7
+# or below — longitudinal tooling never sees a
 # layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 7
+METRICS_SCHEMA_VERSION = 8
+_VALUATION_SCHEMA_VERSION = 7
 _COSTMODEL_SCHEMA_VERSION = 6
 _STREAM_SCHEMA_VERSION = 5
 _ASYNC_SCHEMA_VERSION = 4
@@ -85,6 +90,10 @@ _NON_PROGRAM_FIELDS = (
     "checkpoint_keep_last",
     "resume",
     "data_dir",
+    # Sweep persistence knobs (sweep/engine.py): where completed points
+    # land and whether to resume — pure I/O, never the measured program.
+    "sweep_dir",
+    "sweep_resume",
 )
 
 
@@ -93,7 +102,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
                        async_federation: dict | None = None,
                        stream: dict | None = None,
                        costmodel: dict | None = None,
-                       valuation: dict | None = None) -> dict:
+                       valuation: dict | None = None,
+                       sweep: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -112,15 +122,20 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     (telemetry/costmodel.costmodel_record) upgrades it to v6 under the
     ``"costmodel"`` key; a valuation dict
     (telemetry/valuation.valuation_record) upgrades it to v7 under the
-    ``"valuation"`` key.
+    ``"valuation"`` key; a sweep dict (sweep/engine.py per-point
+    provenance) upgrades it to v8 under the ``"sweep"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
-    ) and stream is None and costmodel is None and valuation is None:
+    ) and stream is None and costmodel is None and valuation is None and (
+        sweep is None
+    ):
         return base
     record = dict(base)
-    if valuation is not None:
+    if sweep is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif valuation is not None:
+        record["schema_version"] = _VALUATION_SCHEMA_VERSION
     elif costmodel is not None:
         record["schema_version"] = _COSTMODEL_SCHEMA_VERSION
     elif stream is not None:
@@ -143,6 +158,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["costmodel"] = costmodel
     if valuation is not None:
         record["valuation"] = valuation
+    if sweep is not None:
+        record["sweep"] = sweep
     return record
 
 
@@ -174,6 +191,13 @@ def config_hash(config) -> str:
         # pre-feature configs keep their pre-feature hash; 'hashed'
         # changes the drawn cohorts and lands in the hash.
         d.pop("participation_sampler", None)
+    if not d.get("sweep_seeds") and not d.get("sweep_points"):
+        # No sweep requested: the sweep knobs drop out at their off
+        # values (pre-feature configs keep their pre-feature hash); an
+        # ACTIVE sweep — which changes what the process runs — lands
+        # its point list and strategy in the hash.
+        for k in ("sweep_seeds", "sweep_points", "sweep_strategy"):
+            d.pop(k, None)
     blob = json.dumps(d, sort_keys=True, default=repr)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 

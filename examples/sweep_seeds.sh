@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Multi-experiment sweep: an 8-seed fleet of the synthetic MLP config as
+# ONE vmapped program (sweep/engine.py — compile paid once, every
+# point's history bit-identical to a solo run with that seed on the
+# shared data), with per-point results + schema-v8 records persisted
+# under --sweep_dir. Re-run with --sweep_resume true after an interrupt
+# to execute only the missing points (bit-identical stitching).
+#
+# Render the sweep afterwards (per-point accuracy table, winner line,
+# compile-reuse summary):
+#   python scripts/report_run.py "$SWEEP_DIR"
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SWEEP_DIR="${SWEEP_DIR:-/tmp/dls_sweep_seeds}"
+
+python -m distributed_learning_simulator_tpu \
+  --dataset_name synthetic \
+  --model_name mlp \
+  --distributed_algorithm fed \
+  --worker_number 32 \
+  --round 20 \
+  --epoch 1 \
+  --learning_rate 0.1 \
+  --batch_size 16 \
+  --n_train 1024 \
+  --n_test 512 \
+  --log_level INFO \
+  --sweep_seeds 0,1,2,3,4,5,6,7 \
+  --sweep_dir "$SWEEP_DIR"
+
+python scripts/report_run.py "$SWEEP_DIR"

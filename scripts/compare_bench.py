@@ -51,7 +51,12 @@ million-client leg model-bound. The
 vector vs cumulative exact-GTG audit SVs on the graded-quality
 differential config, telemetry/valuation.py) gets
 ``--valuation-corr-threshold`` as an absolute floor, default 0.8 —
-the cheap estimator must keep tracking exact Shapley. The
+the cheap estimator must keep tracking exact Shapley. The ``sweep``
+leg's ``sweep_amortization_ratio`` (serial-solo vs vmapped-fleet wall
+for the same points, sweep/engine.py) gets
+``--sweep-amortization-threshold`` as an absolute floor, default 2.0 —
+the fleet must at least halve the sweep's wall-clock (compile paid
+once is the whole multiplier). The
 ``costmodel`` leg's ``model_error_ratio`` per program (predicted /
 measured per-round ms from the roofline model, telemetry/costmodel.py)
 is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
@@ -306,6 +311,34 @@ def valuation_corr_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def sweep_amortization_gate(record: dict, threshold: float) -> dict | None:
+    """In-record sweep-engine gate: bench.py's ``sweep`` leg measures,
+    within one bench run, the wall-clock of N serial solo runs against
+    the same N points executed as one vmapped seed fleet
+    (``sweep_amortization_ratio`` = serial wall / fleet wall; the fleet
+    pays one compile and one dispatch per round for every experiment).
+    A ratio below ``threshold`` means the fleet stopped amortizing —
+    compile or dispatch overhead is being re-paid per point — a
+    regression regardless of the old record. Judged ABSOLUTELY like the
+    other in-record gates (the ratio sits at a fixed operating point set
+    by the compile/run balance, where a relative gate would flap; the
+    PR 4/5/10 precedent). None when the leg is absent or the floor
+    holds."""
+    ratio = get_path(record, "sweep.sweep_amortization_ratio")
+    if ratio is None or ratio >= threshold:
+        return None
+    return {
+        "metric": "sweep.sweep_amortization_ratio",
+        "description": (
+            "serial-solo vs vmapped-fleet wall-clock ratio for the "
+            "same sweep points (>= 2.0 means the fleet at least halves "
+            "the sweep's wall — the acceptance operating point)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def model_drift_gate(record: dict, threshold: float) -> list[dict]:
     """In-record cost-model drift gate: bench.py's ``costmodel`` leg
     records, per proxied program, the roofline model's predicted-vs-
@@ -386,6 +419,13 @@ def main(argv: list[str] | None = None) -> int:
                          "the r07 host-bound 328 c*r/s N=1e6 CPU "
                          "baseline the hashed sampler retired; "
                          "docs/PERFORMANCE.md § Streamed client state)")
+    ap.add_argument("--sweep-amortization-threshold", type=float,
+                    default=2.0,
+                    help="min tolerated serial-vs-fleet wall ratio in the "
+                         "NEW record's sweep leg (default 2.0 — an "
+                         "8-point vmapped seed fleet must finish in under "
+                         "half the wall of 8 serial solo runs; compile "
+                         "paid once is the multiplier)")
     ap.add_argument("--valuation-corr-threshold", type=float, default=0.8,
                     help="min tolerated streaming-valuation vs GTG-audit "
                          "Spearman correlation in the NEW record's "
@@ -426,6 +466,7 @@ def main(argv: list[str] | None = None) -> int:
         async_speedup_gate(new, args.async_speedup_threshold),
         stream_overlap_gate(new, args.stream_overlap_threshold),
         stream_cohort_rate_gate(new, args.stream_cohort_rate_threshold),
+        sweep_amortization_gate(new, args.sweep_amortization_threshold),
         valuation_corr_gate(new, args.valuation_corr_threshold),
     ):
         if gate is not None:

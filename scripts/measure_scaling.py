@@ -9,6 +9,14 @@ here is flat round time per device count only when host cores are not
 saturated; the honest signal is the absence of super-linear SLOWDOWN from
 resharding/collective overhead as the mesh grows).
 
+The mesh points run as ONE sweep (sweep/engine.py, scheduled strategy)
+in ONE worker interpreter instead of the old one-subprocess-per-mesh
+loop: the config-hash grouping runs each mesh size through its own
+program, and — the ISSUE 11 small fix — each point's warmup
+(trace+compile, previously re-paid per invocation and silently dropped
+by the ``history[1:]`` slice) is counted once per program and recorded
+explicitly in the table's ``warmup`` column.
+
 Usage:  python scripts/measure_scaling.py [clients] [rounds]
 Writes a markdown table to stdout (pasted into docs/PERFORMANCE.md).
 """
@@ -25,10 +33,11 @@ _WORKER = textwrap.dedent("""
     import jax
     jax.config.update("jax_platforms", "cpu")
     from distributed_learning_simulator_tpu.config import ExperimentConfig
-    from distributed_learning_simulator_tpu.simulator import run_simulation
+    from distributed_learning_simulator_tpu.sweep import SweepSpec, run_sweep
 
-    mesh, clients, rounds, chunk = (int(a) for a in sys.argv[1:5])
-    config = ExperimentConfig(
+    clients, rounds, chunk = (int(a) for a in sys.argv[1:4])
+    meshes = [int(m) for m in sys.argv[4].split(",")]
+    base = ExperimentConfig(
         dataset_name="synthetic",
         model_name="mlp",
         distributed_algorithm="fed",
@@ -41,21 +50,32 @@ _WORKER = textwrap.dedent("""
         n_test=256,
         log_level="ERROR",
         dataset_args={"difficulty": 0.5},
-        mesh_devices=mesh if mesh > 1 else None,
         client_chunk_size=chunk if chunk > 0 else None,
         compilation_cache_dir=None,
     )
-    res = run_simulation(config, setup_logging=False)
-    steady = [h["round_seconds"] for h in res["history"][1:]]
-    print(json.dumps({
-        "mesh": mesh,
-        "round_s": sum(steady) / len(steady),
-        "acc": res["final_accuracy"],
-    }))
+    # One scheduled sweep over the mesh axis: every point shares the
+    # same data/partition, each mesh size compiles its own program
+    # (different sharding = honestly different program) and records its
+    # warmup explicitly instead of silently dropping round 0.
+    spec = SweepSpec(
+        base,
+        [{"mesh_devices": m if m > 1 else None} for m in meshes],
+        strategy="scheduled",
+    )
+    out = run_sweep(spec)
+    for m, p in zip(meshes, out["points"]):
+        steady = [h["round_seconds"] for h in p["history"][1:]]
+        print(json.dumps({
+            "mesh": m,
+            "round_s": sum(steady) / len(steady),
+            "warmup_s": p["warmup_seconds"],
+            "acc": p["final_accuracy"],
+        }))
 """)
 
 
-def measure(mesh: int, clients: int, rounds: int, chunk: int) -> dict:
+def measure(meshes: list[int], clients: int, rounds: int,
+            chunk: int) -> list[dict]:
     env = dict(os.environ)
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "")
@@ -64,29 +84,35 @@ def measure(mesh: int, clients: int, rounds: int, chunk: int) -> dict:
     env.pop("JAX_PLATFORMS", None)
     repo = os.path.join(os.path.dirname(__file__), "..")
     proc = subprocess.run(
-        [sys.executable, "-c", _WORKER, str(mesh), str(clients),
-         str(rounds), str(chunk)],
-        cwd=repo, env=env, capture_output=True, text=True, timeout=1800,
+        [sys.executable, "-c", _WORKER, str(clients), str(rounds),
+         str(chunk), ",".join(str(m) for m in meshes)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=3600,
     )
     if proc.returncode != 0:
         raise RuntimeError(proc.stderr[-2000:])
     import json
 
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    return [
+        json.loads(line)
+        for line in proc.stdout.strip().splitlines()[-len(meshes):]
+    ]
 
 
 def main():
     clients = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 5
     chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 0
-    rows = [measure(m, clients, rounds, chunk) for m in (1, 2, 4, 8)]
+    rows = measure([1, 2, 4, 8], clients, rounds, chunk)
     base = rows[0]["round_s"]
     print(f"\n{clients} clients x {rounds} rounds, mlp, synthetic data, "
-          f"chunk={chunk or 'none'} (virtual CPU devices)\n")
-    print("| mesh devices | round (s) | vs 1-device | accuracy |")
-    print("|---|---|---|---|")
+          f"chunk={chunk or 'none'} (virtual CPU devices; one sweep, "
+          f"warmup recorded per program)\n")
+    print("| mesh devices | round (s) | warmup (s) | vs 1-device "
+          "| accuracy |")
+    print("|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['mesh']} | {r['round_s']:.3f} "
+              f"| {r['warmup_s']:.2f} "
               f"| {base / r['round_s']:.2f}x | {r['acc']:.3f} |")
 
 

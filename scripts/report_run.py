@@ -35,7 +35,11 @@ from the trace's categorized ledger when the records don't carry one
 sub-object — rendered as the client-valuation section: latest
 top-k/bottom-k client tables, the loss-delta curve, the
 flagged-client overlay against the v3 client-health section, and the
-latest GTG audit-correlation line; telemetry/valuation.py). The only
+latest GTG audit-correlation line; telemetry/valuation.py), and v8
+(``sweep`` sub-object — rendered as the sweep section: per-point
+accuracy table, winner line, compile-reuse summary, and — when a trace
+is attached (``--trace``) — the cost model's $/sweep row per topology;
+sweep/engine.py). The only
 heavy import (jax, via utils.tracing) is deferred behind ``--trace``,
 so metrics-only reporting is instant.
 """
@@ -256,6 +260,60 @@ def summarize_async(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_sweep(records: list[dict]) -> dict | None:
+    """Aggregate schema-v8 ``sweep`` sub-objects into the sweep summary:
+    the per-point accuracy table, the winner, and the compile-reuse
+    bookkeeping (which points rode a warm program — the amortization the
+    sweep engine exists for). None when no record belongs to a sweep."""
+    by_point: dict[int, dict] = {}
+    for r in records:
+        sw = r.get("sweep")
+        if not isinstance(sw, dict):
+            continue
+        entry = by_point.setdefault(sw["point"], {
+            "point": sw["point"],
+            "seed": sw.get("seed"),
+            "lr": sw.get("lr"),
+            "strategy": sw.get("strategy"),
+            "group": sw.get("group"),
+            "compile_reused": bool(sw.get("compile_reused")),
+            "rounds": 0,
+            "accuracies": [],
+        })
+        entry["rounds"] += 1
+        if r.get("test_accuracy") is not None:
+            entry["accuracies"].append(r["test_accuracy"])
+    if not by_point:
+        return None
+    points = []
+    for idx in sorted(by_point):
+        e = by_point[idx]
+        accs = e.pop("accuracies")
+        e["final_accuracy"] = accs[-1] if accs else None
+        e["best_accuracy"] = max(accs) if accs else None
+        points.append(e)
+    scored = [p for p in points if p["final_accuracy"] is not None]
+    winner = (
+        max(scored, key=lambda p: p["final_accuracy"]) if scored else None
+    )
+    reused = sum(1 for p in points if p["compile_reused"])
+    return {
+        "n_points": len(points),
+        "strategies": sorted({p["strategy"] for p in points
+                              if p["strategy"]}),
+        "groups": len({p["group"] for p in points}),
+        "rounds_total": sum(p["rounds"] for p in points),
+        "compile_reuse_fraction": round(reused / len(points), 4),
+        "points": points,
+        "winner": (
+            {"point": winner["point"], "seed": winner["seed"],
+             "lr": winner["lr"],
+             "final_accuracy": winner["final_accuracy"]}
+            if winner else None
+        ),
+    }
+
+
 def summarize_run(records: list[dict], trace_stats: dict | None = None,
                   top_ops: list[dict] | None = None,
                   top_ops_time: list[dict] | None = None,
@@ -404,6 +462,11 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     async_summary = summarize_async(records)
     if async_summary is not None:
         summary["async_federation"] = async_summary
+
+    # --- sweep sub-objects (schema v8, sweep/engine.py) ---------------------
+    sweep_summary = summarize_sweep(records)
+    if sweep_summary is not None:
+        summary["sweep"] = sweep_summary
 
     # --- costmodel sub-object (schema v6, cost_model_trace) -----------------
     # Explicit costmodel (computed live from --trace) wins; otherwise the
@@ -640,6 +703,57 @@ def render_summary(summary: dict) -> list[str]:
                 f"vs {a['sim_clock_sync_s']:.1f}s sync — "
                 f"{a['speedup_vs_sync']:.2f}x speedup"
             )
+    if "sweep" in summary:
+        sw = summary["sweep"]
+        strategies = "/".join(sw["strategies"]) or "?"
+        lines.append(
+            f"sweep: {sw['n_points']} point(s), strategy {strategies}, "
+            f"{sw['groups']} config-hash group(s), "
+            f"{sw['rounds_total']} experiment-rounds"
+        )
+        lines.append(
+            f"  compile reuse: {sw['compile_reuse_fraction']:.0%} of "
+            "points rode a warm program"
+        )
+        lines.append("  point  seed        lr  warm  final acc  best acc")
+        for p in sw["points"]:
+            fin = (
+                f"{p['final_accuracy']:.4f}"
+                if p["final_accuracy"] is not None else "n/a"
+            )
+            best = (
+                f"{p['best_accuracy']:.4f}"
+                if p["best_accuracy"] is not None else "n/a"
+            )
+            lr = f"{p['lr']:.4g}" if p["lr"] is not None else "?"
+            warm = "yes" if p["compile_reused"] else "no"
+            lines.append(
+                f"  {p['point']:>5}  {p['seed']!s:>4}  {lr:>8}  "
+                f"{warm:>4}  {fin:>9}  {best:>8}"
+            )
+        if sw["winner"] is not None:
+            w = sw["winner"]
+            lines.append(
+                f"  winner: point {w['point']} (seed {w['seed']}, "
+                f"lr {w['lr']:.4g}) at {w['final_accuracy']:.4f}"
+            )
+        cm = summary.get("costmodel")
+        if cm is not None and cm.get("per_topology"):
+            # $/sweep (telemetry/costmodel.py pricing discipline): the
+            # compiled program priced once, multiplied by the sweep's
+            # experiment-round occupancy — per topology-table entry.
+            lines.append(
+                f"  $/sweep ({sw['rounds_total']} experiment-rounds):"
+            )
+            for name, t in cm["per_topology"].items():
+                usd = t.get("usd_per_round")
+                if usd is None:
+                    continue
+                lines.append(
+                    f"    {name:<10} ${usd * sw['rounds_total']:.4f}"
+                    f"  (x{t['chips']} chips, "
+                    f"{t['predicted_ms']:.1f} ms/round predicted)"
+                )
     if "costmodel" in summary:
         # "What would this cost at scale": the roofline prediction per
         # topology-table entry, measured run as the anchor row.

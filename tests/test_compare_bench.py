@@ -345,6 +345,50 @@ def test_valuation_corr_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_sweep_amortization_not_relatively_tracked(cb):
+    """The serial-vs-fleet wall ratio sits at the operating point the
+    compile/run balance sets — like every other in-record ratio it must
+    never be a relative TRACKED metric; only the absolute floor judges
+    it."""
+    old = _record(sweep={"sweep_amortization_ratio": 5.0})
+    new = _record(sweep={"sweep_amortization_ratio": 2.6})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "sweep" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_sweep_amortization_self_gate(cb, tmp_path):
+    """In-record absolute floor: a vmapped fleet that stops amortizing
+    its compile/dispatch (ratio under the floor) gates on the NEW
+    record alone."""
+    assert cb.sweep_amortization_gate(_record(), 2.0) is None  # absent
+    ok = _record(sweep={"sweep_amortization_ratio": 3.4,
+                        "compile_reuse_fraction": 0.875})
+    assert cb.sweep_amortization_gate(ok, 2.0) is None
+    bad = _record(sweep={"sweep_amortization_ratio": 1.3})
+    entry = cb.sweep_amortization_gate(bad, 2.0)
+    assert entry and entry["new"] == 1.3 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "sweep.sweep_amortization_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--sweep-amortization-threshold", "1.0"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_model_drift_not_relatively_tracked(cb):
     """model_error_ratio sits near 1.0 — like the other in-record
     ratios it must never be a relative TRACKED metric (PR 4/5

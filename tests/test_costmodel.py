@@ -205,6 +205,38 @@ def test_costmodel_record_without_measurement():
     assert "run_rounds" not in rec
 
 
+def test_sweep_cost_record_hand_computed():
+    """$/sweep (ISSUE 11): the compiled program priced once, multiplied
+    by the sweep's experiment-round occupancy — device work does not
+    amortize, only the compile does (the reuse fraction records it)."""
+    from distributed_learning_simulator_tpu.telemetry.costmodel import (
+        sweep_cost_record,
+    )
+
+    topos = {"toy": _toy(), "toy-4": _toy(chips=4)}
+    rec = sweep_cost_record(
+        _ledger(), points=8, rounds_total=48, programs_compiled=1,
+        anchor="toy", topologies=topos, efficiency=_EXACT,
+    )
+    assert rec["anchor_topology"] == "toy"
+    assert rec["points"] == 8 and rec["rounds_total"] == 48
+    # 8 points, 1 program: 7/8 of points rode a warm program — the
+    # acceptance bookkeeping.
+    assert rec["compile_reuse_fraction"] == pytest.approx(7 / 8)
+    # toy: 1 GiB over 1 GiB/s = 1 s/round -> 0.001 USD/round at 3.6
+    # USD/chip-hour; the sweep occupies 48 experiment-rounds.
+    toy = rec["per_topology"]["toy"]
+    assert toy["usd_per_sweep"] == pytest.approx(0.048)
+    assert toy["usd_per_point"] == pytest.approx(0.006)
+    # 4 chips split the bytes 4x but cost 4x the chip-hours: same $.
+    assert rec["per_topology"]["toy-4"]["usd_per_sweep"] == (
+        pytest.approx(0.048)
+    )
+    with pytest.raises(ValueError, match="points"):
+        sweep_cost_record(_ledger(), points=0, rounds_total=1,
+                          programs_compiled=0, topologies=topos)
+
+
 def test_costmodel_record_validates_against_metrics_schema():
     """The record the builder emits IS the schema-v6 sub-object — pin it
     against the same checked-in JSON schema the metrics tests use."""

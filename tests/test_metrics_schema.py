@@ -2,13 +2,14 @@
 
 Jax-free (imports only utils.reporting + jsonschema): the schema at
 tests/data/metrics_record.schema.json is the reviewable contract every
-emitter (vmap simulator, threaded oracle) writes through
+emitter (vmap simulator, threaded oracle, sweep engine) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
-(+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel) and v7
-(+valuation) records must validate; records that mix versions and
-sub-objects inconsistently must not. The integration tests in
-test_client_stats.py (test_costmodel.py for v6, test_valuation.py for
-v7) validate REAL produced records against the same file.
+(+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel), v7
+(+valuation) and v8 (+sweep) records must validate; records that mix
+versions and sub-objects inconsistently must not. The integration tests
+in test_client_stats.py (test_costmodel.py for v6, test_valuation.py
+for v7, test_sweep.py for v8) validate REAL produced records against
+the same file.
 """
 
 import json
@@ -248,7 +249,7 @@ def test_v7_record_validates():
         _base(), _telemetry(), _client_stats(), _async(), _stream(),
         _costmodel(), _valuation(),
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 7
+    assert record["schema_version"] == 7
     validate(record)
     # valuation alone (every other feature off) is still v7 — a
     # client_valuation='on' run with telemetry_level='off' ... except
@@ -276,8 +277,42 @@ def test_v7_record_validates():
     ))
 
 
+def _sweep() -> dict:
+    return {
+        "point": 3,
+        "seed": 7,
+        "lr": 0.1,
+        "strategy": "vmapped",
+        "group": "9c2f3e1a4b5d",
+        "compile_reused": True,
+        "experiments": 8,
+    }
+
+
+def test_v8_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async(), _stream(),
+        _costmodel(), _valuation(), _sweep(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 8
+    validate(record)
+    # sweep alone (every other feature off) is still v8 — the sweep
+    # engine's per-point records at defaults.
+    validate(build_round_record(_base(), sweep=_sweep()))
+    # Scheduled points carry no fleet width (experiments is vmapped-only)
+    # and may carry the usual round extras (cohort_hash, lr_factor).
+    sched = {k: v for k, v in _sweep().items() if k != "experiments"}
+    sched["strategy"] = "scheduled"
+    sched["compile_reused"] = False
+    validate(build_round_record(
+        {**_base(), "cohort_hash": 12345, "lr_factor": 0.5,
+         "mean_client_loss": 1.2},
+        sweep=sched,
+    ))
+
+
 def test_lowest_version_stamping_preserved():
-    """Adding v7 must not disturb the lower stamps: the version is the
+    """Adding v8 must not disturb the lower stamps: the version is the
     LOWEST that describes the record (longitudinal byte-identity)."""
     assert "schema_version" not in build_round_record(_base())
     assert build_round_record(_base(), _telemetry())[
@@ -290,6 +325,8 @@ def test_lowest_version_stamping_preserved():
         "schema_version"] == 5
     assert build_round_record(_base(), None, None, None, None,
                               _costmodel())["schema_version"] == 6
+    assert build_round_record(_base(), None, None, None, None, None,
+                              _valuation())["schema_version"] == 7
 
 
 def test_version_content_mismatches_rejected():
@@ -398,6 +435,27 @@ def test_version_content_mismatches_rejected():
             _base(), None, None, None, None, None,
             {**_valuation(), **poison},
         )
+        with pytest.raises(jsonschema.ValidationError):
+            validate(bad)
+    # v7 stamp smuggling a sweep sub-object (the builder always stamps
+    # sweep records v8).
+    bad = build_round_record(_base(), None, None, None, None, None,
+                             _valuation())
+    bad["sweep"] = _sweep()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v8 stamp without the sweep sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 8
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown keys / a strategy outside the vmapped/scheduled enum are
+    # schema breaks, not silent extensions.
+    for poison in (
+        {"mystery": 1},
+        {"strategy": "psychic"},
+    ):
+        bad = build_round_record(_base(), sweep={**_sweep(), **poison})
         with pytest.raises(jsonschema.ValidationError):
             validate(bad)
 
