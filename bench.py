@@ -75,6 +75,13 @@ GTG audit SVs on the small-N graded-label differential — gated
 absolutely by compare_bench.py (--valuation-corr-threshold);
 BENCH_VALUATION=0 skips, BENCH_VALUATION_ROUNDS /
 BENCH_VALUATION_FIDELITY_N/_ROUNDS set the two measurements. The
+``churn`` sub-object (robustness/population.py) runs a 10x
+population-growth ``population='dynamic'`` leg against the same
+program static on the headline data (streamed + hashed + sampled) and
+records ``churn_overhead_ratio`` — gated absolutely by
+compare_bench.py (--churn-overhead-threshold, default 0.10);
+BENCH_CHURN=0 skips, BENCH_CHURN_ROUNDS / BENCH_CHURN_GROWTH set the
+horizon and growth target. The
 ``sweep`` sub-object (sweep/engine.py) measures the multi-experiment
 sweep engine: an N-point vmapped seed fleet vs N serial solo runs
 (``sweep_amortization_ratio`` = serial/fleet wall, gated absolutely by
@@ -813,6 +820,75 @@ def main():
         # --valuation-corr-threshold reads valuation.audit_spearman).
         valuation_rec["audit_spearman"] = last.get("spearman")
         record["valuation"] = valuation_rec
+
+    # Open-world churn (ISSUE 13, config.population;
+    # robustness/population.py): a 10x population-growth dynamic run on
+    # the 1000-client headline data vs the SAME program static. Both
+    # legs run the streamed + hashed + sampled composition (the one
+    # dynamic populations require — the cohort stays pinned while N
+    # grows), so churn_overhead_ratio isolates exactly what the
+    # registration stream adds: the masked cohort draw, per-round event
+    # draws over the alive population, join-shard packing + store
+    # growth, drift label mutation, and the synchronous (non-prefetched)
+    # cohort gather. Gated by scripts/compare_bench.py
+    # --churn-overhead-threshold as an in-record ABSOLUTE ceiling
+    # (default 0.10, never relatively tracked — the PR 4 overhead-gate
+    # precedent). The population knobs are program-defining config
+    # fields, so the dynamic leg's config_hash differs from the static
+    # leg's automatically (at 'static' they drop out — pre-feature
+    # hashes unchanged). BENCH_CHURN=0 skips; BENCH_CHURN_ROUNDS /
+    # BENCH_CHURN_GROWTH set the horizon and the growth target.
+    run_churn = (
+        os.environ.get("BENCH_CHURN", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_churn:
+        ch_rounds = int(os.environ.get("BENCH_CHURN_ROUNDS", "10"))
+        ch_growth = float(os.environ.get("BENCH_CHURN_GROWTH", "10"))
+        churn_knobs = dict(
+            model_name=model, round=ch_rounds + 1,
+            client_chunk_size=chunk, local_compute_dtype=dtype,
+            client_residency="streamed", participation_sampler="hashed",
+            participation_fraction=0.25,
+        )
+        chs_config = ExperimentConfig(**churn_knobs, **common)
+        chs_times, _ = _run(
+            chs_config, dataset=dataset, client_data=client_data
+        )
+        chs_r = _rates(chs_times, n_clients)
+        # Integer join rate -> a deterministic growth schedule landing
+        # ~on the target population at the horizon. The run executes
+        # ch_rounds + 1 rounds (round 0 carries the compile, like every
+        # leg) and the registration stream joins clients in EVERY
+        # executed round, so the rate is sized over ch_rounds + 1.
+        join_rate = round(
+            (ch_growth - 1.0) * n_clients / (ch_rounds + 1)
+        )
+        chd_config = ExperimentConfig(
+            population="dynamic", join_rate=float(join_rate),
+            depart_rate=0.01, drift_fraction=0.02, drift_factor=0.5,
+            **churn_knobs, **common,
+        )
+        chd_times, chd_result = _run(
+            chd_config, dataset=dataset, client_data=client_data
+        )
+        chd_r = _rates(chd_times, n_clients)
+        record["churn"] = {
+            "rounds": ch_rounds,
+            "growth_target": ch_growth,
+            "join_rate": join_rate,
+            "static_round_ms": round(chs_r["round_ms"]["median"], 1),
+            "dynamic_round_ms": round(chd_r["round_ms"]["median"], 1),
+            # The gate's number (compare_bench.py reads
+            # churn.churn_overhead_ratio): dynamic-vs-static median
+            # round time, minus one.
+            "churn_overhead_ratio": round(
+                chd_r["round_ms"]["median"] / chs_r["round_ms"]["median"]
+                - 1.0, 4,
+            ),
+            "population": chd_result["population_summary"],
+        }
 
     # Streamed client residency (ISSUE 7, config.client_residency): the
     # population-scale leg. An N-sweep of synthetic populations (cohort

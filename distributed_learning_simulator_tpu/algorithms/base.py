@@ -180,8 +180,16 @@ class Algorithm:
         return None
 
     # ---- streamed residency (config.client_residency='streamed') -----------
-    def cohort_indices(self, round_key, n_clients: int):
+    def cohort_indices(self, round_key, n_clients: int, alive=None,
+                       n_participants=None):
         """Host-replay of the round program's cohort draw.
+
+        ``alive``/``n_participants`` are the dynamic-population hooks
+        (``population='dynamic'``, robustness/population.py): a draw
+        over the current registered index space with departed indices
+        masked, at the pinned startup cohort size. Algorithms that
+        support dynamic populations honor them (FedAvg); the default
+        whole-population replay ignores them.
 
         Under streamed residency the host must know WHICH clients round
         ``round_key`` trains BEFORE dispatch (to gather their slice from

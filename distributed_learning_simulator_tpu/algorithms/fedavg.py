@@ -229,7 +229,8 @@ class FedAvg(Algorithm):
         broadcast). Returns (params, extra_aux)."""
         return global_params, {}
 
-    def cohort_indices(self, round_key, n_clients: int):
+    def cohort_indices(self, round_key, n_clients: int, alive=None,
+                       n_participants=None):
         """Host-replay of the round program's cohort draw (base contract).
 
         MUST mirror ``split_round_key`` + the in-program
@@ -242,9 +243,17 @@ class FedAvg(Algorithm):
         is the resident cohort bit-for-bit); under ``hashed`` the
         replay is the O(cohort) numpy mirror of the same keyed-hash
         stream — identical indices by construction, no full-N work.
+
+        ``alive``/``n_participants`` serve ``population='dynamic'``
+        (robustness/population.py): the draw runs over the CURRENT
+        registered index space (``n_clients`` grows) with departed
+        indices masked out of the hashed stream, and the cohort size is
+        PINNED at the startup population's (so the round program's
+        shapes never change) instead of re-derived from the growing N.
         """
         cfg = self.config
-        n_participants = cfg.cohort_size(n_clients)
+        if n_participants is None:
+            n_participants = cfg.cohort_size(n_clients)
         if n_participants == n_clients:
             return None
         with_faults = FailureModel.from_config(cfg) is not None
@@ -259,9 +268,11 @@ class FedAvg(Algorithm):
             return draw_cohort_host(
                 None, n_clients, n_participants, sampler,
                 key_words=_hashed_part_key_words(round_key, with_faults),
+                alive=alive,
             )
         part_key = round_key_splits(round_key, with_faults)[0]
-        return draw_cohort_host(part_key, n_clients, n_participants, sampler)
+        return draw_cohort_host(part_key, n_clients, n_participants,
+                                sampler, alive=alive)
 
     def make_round_fn(self, apply_fn, optimizer, n_clients: int,
                       preprocess=None, client_sizes=None):
@@ -602,20 +613,34 @@ class FedAvg(Algorithm):
             return round_key_splits(key, fm is not None)
 
         def cohort_round(global_params, state_k, x_k, y_k, m_k, part_sizes,
-                         idx, key, keys, lr_scale, async_state):
+                         idx, key, keys, lr_scale, async_state,
+                         departed=None):
             """The round body AFTER the cohort gather — shared verbatim by
             the resident entry (which gathered in-program) and the
             streamed entry (whose operands arrived pre-gathered from the
             host store), which is what makes the two residency modes
             bit-identical by construction. ``idx`` is the cohort's true
             client ids (None = whole population); the returned
-            ``new_state_k`` is cohort-sliced and NOT yet scattered."""
+            ``new_state_k`` is cohort-sliced and NOT yet scattered.
+            ``departed`` (bool[cohort]; population='dynamic' only) marks
+            members that depart THIS round — zero contribution, counted
+            against the quorum floor."""
             _, train_key, payload_key, agg_key, fault_key = keys
             if fm is not None:
                 failed = fm.draw_failed(fault_key, n_participants)
                 survival = ~failed
             else:
                 failed = None
+            if departed is not None:
+                # Dynamic population (robustness/population.py): a
+                # member that departs mid-round contributes nothing —
+                # its weight zeroes and the remaining cohort
+                # renormalizes, exactly the dropout-fault discipline;
+                # the quorum policy counts it against min_survivors
+                # below.
+                part_sizes = part_sizes * (~departed).astype(
+                    part_sizes.dtype
+                )
             client_keys = jax.random.split(train_key, n_participants)
             routed_late = None
             if failed is not None and fm.excludes_update:
@@ -831,9 +856,20 @@ class FedAvg(Algorithm):
                 # NaN event) and INSTEAD of the robust-rule finite guard,
                 # which it subsumes; in-program jnp.where keeps the whole
                 # round one XLA program (no host sync to decide).
+                if failed is not None and departed is not None:
+                    survived = survival & (~departed)
+                elif failed is not None:
+                    survived = survival
+                elif departed is not None:
+                    # Dynamic population, no failure model: departures
+                    # alone can push a round below the quorum floor —
+                    # the graceful-degradation contract.
+                    survived = ~departed
+                else:
+                    survived = None
                 survivor_count = (
-                    jnp.sum(survival.astype(jnp.int32))
-                    if failed is not None
+                    jnp.sum(survived.astype(jnp.int32))
+                    if survived is not None
                     else jnp.asarray(n_participants, jnp.int32)
                 )
                 finite = all_finite(new_global)
@@ -940,9 +976,18 @@ class FedAvg(Algorithm):
         if not streamed:
             return round_fn
 
+        # Dynamic population (config.population; robustness/population.py):
+        # a trace-time gate like fm/cs/af — 'static' (the default)
+        # compiles the exact pre-feature streamed program; 'dynamic'
+        # adds the per-cohort ``departed`` operand (validated streamed-
+        # only, so the resident entry never grows it).
+        dyn = (
+            getattr(cfg, "population", "static") or "static"
+        ).lower() == "dynamic"
+
         def round_fn_streamed(global_params, state_k, x_k, y_k, m_k,
                               part_sizes, idx, key, lr_scale=1.0,
-                              async_state=None):
+                              async_state=None, departed=None):
             """Streamed calling convention (base.Algorithm docstring): the
             cohort slice arrives pre-gathered from the host shard store,
             ``idx`` is its true client ids (None = whole population), and
@@ -950,16 +995,29 @@ class FedAvg(Algorithm):
             streamer writes it back into the host store. The round key is
             split exactly as in the resident program (part_key is
             consumed by the host's cohort replay instead of an in-program
-            choice), so every downstream draw is unchanged."""
+            choice), so every downstream draw is unchanged. ``departed``
+            (population='dynamic') is the host registration stream's
+            this-round departure mask over the cohort."""
             if af is not None and async_state is None:
                 raise ValueError(
                     "async_mode='on' round program needs the async_state "
                     "operand (AsyncFederation.init_state)"
                 )
+            if dyn and departed is None:
+                # Trace-time wiring check, like the async one above: the
+                # simulator owns the registration stream; a direct
+                # caller forgetting the mask would silently train
+                # departed clients at full weight.
+                raise ValueError(
+                    "population='dynamic' round program needs the "
+                    "departed operand "
+                    "(PopulationModel.cohort_departed_mask)"
+                )
             keys = split_round_key(key)
             new_global, new_state_k, aux = cohort_round(
                 global_params, state_k, x_k, y_k, m_k, part_sizes, idx,
                 key, keys, lr_scale, async_state,
+                departed=departed if dyn else None,
             )
             if idx is not None:
                 aux["participants"] = idx

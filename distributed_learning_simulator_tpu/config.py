@@ -40,6 +40,11 @@ SWEEP_STRATEGIES = ("auto", "vmapped", "scheduled")
 # data-dependent subset evaluation no shared program can serve).
 SHAPLEY_ALGORITHMS = ("multiround_shapley_value", "GTG_shapley_value")
 
+# Valid population values (robustness/population.py). Same import-light
+# placement rationale as TELEMETRY_LEVELS — the population module pulls
+# in the sampler implementations.
+POPULATION_MODES = ("static", "dynamic")
+
 
 @dataclass
 class ExperimentConfig:
@@ -117,6 +122,53 @@ class ExperimentConfig:
     # Re-rolls WHICH clients fail without touching cohort sampling,
     # training batches, or payload keys (fold_in-decoupled stream).
     failure_seed: int = 0
+    # --- open-world population (robustness/population.py) -------------------
+    # "static" (default): the fixed client population every prior build
+    # assumed — the exact pre-feature program (bit-identical history,
+    # byte-identical records, config_hash unchanged, 0 post-warmup
+    # compiles; the established off-gate contract). "dynamic": an
+    # open-world population driven by a round-key-chained registration
+    # stream — per round, new clients JOIN (``join_rate``; their data
+    # shards are drawn over a growing index space), existing clients
+    # DEPART (``depart_rate``; departed indices are masked out of the
+    # hashed sampler's first-k-distinct stream and never resampled), and
+    # a planted cohort DRIFTS (``drift_fraction``/``drift_factor``:
+    # graded label-noise ramping in on a schedule). The per-round cohort
+    # stays pinned at the STARTUP population's cohort size, so the
+    # compiled round program never changes shape while N grows. Requires
+    # client_residency='streamed' + participation_sampler='hashed' +
+    # participation_fraction < 1 and the FedAvg family (fed, fed_quant);
+    # composes with faults/quorum (a round whose survivors fall below
+    # min_survivors after mid-round departures is rejected in-program,
+    # previous global retained) and single-host mesh; refuses async
+    # mode, round batching, valuation audits, the threaded oracle, and
+    # the vmapped sweep strategy — each with the blocking cause named
+    # (docs/ROBUSTNESS.md § Dynamic populations).
+    population: str = "static"
+    # Decouples the registration stream from every other round-key
+    # consumer (the PR 2/6 fold_in discipline): re-rolling it changes
+    # WHO joins/departs without touching cohort sampling, training
+    # batches, fault draws, or payload keys.
+    population_seed: int = 0
+    # Expected joins per round: floor(join_rate) clients join every
+    # round, plus one more with probability frac(join_rate) (drawn from
+    # the registration stream). Integer rates give a deterministic
+    # growth schedule.
+    join_rate: float = 0.0
+    # Per-round departure probability of each alive client. Departures
+    # are capped so the alive population never falls below the pinned
+    # cohort size (the sampler must still fill a cohort); a departure
+    # that hits a client sampled in the SAME round zeroes its
+    # contribution in-program (quorum-visible).
+    depart_rate: float = 0.0
+    # Fraction of the STARTUP population planted as a drifting-quality
+    # cohort: member i's labels are progressively corrupted toward its
+    # grade (drift_factor * rank/m of its samples re-labeled uniformly
+    # at random), ramping linearly over the run — the engineered ground
+    # truth the streaming valuation is measured against.
+    drift_fraction: float = 0.0
+    # Peak label-corruption fraction of the worst drifting client.
+    drift_factor: float = 0.5
     # --- asynchronous federation (robustness/arrivals.py) -------------------
     # "off" (default): every algorithm runs its exact synchronous-round
     # program (the async machinery is never constructed — trace-time
@@ -800,6 +852,95 @@ class ExperimentConfig:
                     "(each remote host's cohort shard would cross DCN "
                     "every dispatch); use client_residency='resident' "
                     "with multihost, or streamed on one host's mesh"
+                )
+        if self.population.lower() not in POPULATION_MODES:
+            raise ValueError(
+                f"unknown population {self.population!r}; known: "
+                + ", ".join(POPULATION_MODES)
+            )
+        if self.join_rate < 0.0:
+            raise ValueError("join_rate must be >= 0")
+        if not 0.0 <= self.depart_rate < 1.0:
+            raise ValueError("depart_rate must be in [0, 1)")
+        if not 0.0 <= self.drift_fraction <= 1.0:
+            raise ValueError("drift_fraction must be in [0, 1]")
+        if not 0.0 <= self.drift_factor <= 1.0:
+            raise ValueError("drift_factor must be in [0, 1]")
+        if self.population.lower() == "dynamic":
+            # Every refusal names the blocking feature (the PR 2/6/7
+            # discipline): dynamic populations are an open-world
+            # scenario layer, and each composition below is either
+            # pinned by a test or refused here with its cause.
+            if self.execution_mode.lower() == "threaded":
+                raise ValueError(
+                    "population='dynamic' requires the vmap execution "
+                    "mode: the thread-per-client oracle spawns one OS "
+                    "thread per client at startup and cannot register "
+                    "or retire clients mid-run"
+                )
+            if self.distributed_algorithm not in ("fed", "fed_quant"):
+                cause = (
+                    "its utility memo assumes a fixed cohort over a "
+                    "fixed population"
+                    if self.distributed_algorithm in SHAPLEY_ALGORITHMS
+                    else "its round program does not take the dynamic-"
+                         "population departure operand (FedAvg family "
+                         "only: fed, fed_quant)"
+                )
+                raise ValueError(
+                    f"algorithm {self.distributed_algorithm!r} does not "
+                    f"support population='dynamic': {cause}"
+                )
+            if self.client_residency.lower() != "streamed":
+                raise ValueError(
+                    "population='dynamic' requires client_residency="
+                    "'streamed': the resident path bakes the population "
+                    "length into every device array shape, so each join "
+                    "round would recompile the round program; the "
+                    "streamed cohort pipeline is population-size-free "
+                    "(the host shard store grows by appending)"
+                )
+            if self.participation_sampler.lower() != "hashed":
+                raise ValueError(
+                    "population='dynamic' requires participation_sampler"
+                    "='hashed': the exact sampler's O(N log N) "
+                    "permutation draw has no maskable stream; the hashed "
+                    "first-k-distinct stream masks departed indices "
+                    "exactly (ops/sampling.py)"
+                )
+            if self.participation_fraction >= 1.0:
+                raise ValueError(
+                    "population='dynamic' requires participation_fraction"
+                    " < 1: the cohort is pinned at the startup "
+                    "population's sampled size so the compiled round "
+                    "program never changes shape while N grows; a "
+                    "full-participation cohort would have to grow with "
+                    "the population"
+                )
+            if self.rounds_per_dispatch > 1:
+                raise ValueError(
+                    "population='dynamic' requires rounds_per_dispatch=1:"
+                    " registration events (joins/departures/drift) apply "
+                    "at host round boundaries, which a fused K-round "
+                    "scan dispatch does not expose"
+                )
+            if self.async_mode.lower() == "on":
+                raise ValueError(
+                    "population='dynamic' does not compose with "
+                    "async_mode='on': the persistent per-client arrival "
+                    "speed table is built into the round program at "
+                    "trace time for the startup population — a joined "
+                    "client has no speed row; set async_mode='off'"
+                )
+            if self.valuation_audit_every > 0:
+                raise ValueError(
+                    "population='dynamic' does not compose with "
+                    "valuation audits: the auditor replays cohorts from "
+                    "a startup snapshot of the packed shards, which "
+                    "churn (joins and drifting labels) invalidates; set "
+                    "valuation_audit_every=0 (the streaming valuation "
+                    "itself composes — its vector grows with the "
+                    "population)"
                 )
         if self.rounds_per_dispatch < 1:
             raise ValueError("rounds_per_dispatch must be >= 1")

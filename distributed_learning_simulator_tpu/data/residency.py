@@ -84,6 +84,14 @@ class HostShardStore:
         self.mask = np.ascontiguousarray(mask)
         self.sizes = np.ascontiguousarray(sizes)
         self.state = state
+        # Growth backing (population='dynamic', :meth:`grow`): empty
+        # until the first append — the static path pays nothing. Once
+        # growing, every grown array (data shards, state-tree leaves,
+        # the valuation vector) becomes a view of a capacity-doubling
+        # backing buffer keyed here by name/leaf position, so resident
+        # rows are not re-copied on every join round (amortized O(rows
+        # appended)).
+        self._grow_backing: dict = {}
         # Per-client valuation vector (telemetry/valuation.py): attached
         # by ValuationState when client_valuation='on' under streamed
         # residency, so the store stays the ONE owner of every full-N
@@ -169,6 +177,110 @@ class HostShardStore:
             return full
 
         self.state = tree_map_np(put, self.state, cohort_state)
+
+    def attach_state(self, state) -> None:
+        """Adopt a full-N per-client state tree after construction (the
+        dynamic-population resume path builds the store before the
+        checkpointed — possibly grown — state is ready). Length-checked
+        like the constructor does."""
+        for leaf in tree_leaves_np(state):
+            arr = np.asarray(leaf)
+            if arr.ndim >= 1 and arr.shape[0] != self.n_clients:
+                raise ValueError(
+                    "per-client state leaf has client-axis length "
+                    f"{arr.shape[0]}, store has {self.n_clients}"
+                )
+        self.state = state
+
+    def grow(self, x, y, mask, sizes, state_rows=None) -> int:
+        """Append joined clients' rows (population='dynamic',
+        robustness/population.py); returns the first new client index.
+
+        Every grown array — the data shards, the per-client state tree's
+        leaves (when the algorithm carries any; ``state_rows`` supplies
+        the joiners' rows, same tree structure), and the attached
+        valuation vector (zeros: a joiner starts with no contribution
+        evidence) — moves to capacity-doubling backing buffers on its
+        first growth, so resident rows are copied at most O(log N) times
+        over any growth schedule — never per join round.
+        """
+        x = np.asarray(x)
+        n_new = x.shape[0]
+        if n_new == 0:
+            return self.n_clients
+        rows = {
+            "x": x, "y": np.asarray(y), "mask": np.asarray(mask),
+            "sizes": np.asarray(sizes),
+        }
+        for name, new_rows in rows.items():
+            cur = getattr(self, name)
+            if new_rows.shape[0] != n_new or (
+                new_rows.shape[1:] != cur.shape[1:]
+            ):
+                raise ValueError(
+                    f"joined {name} rows have shape {new_rows.shape}, "
+                    f"store rows are {cur.shape[1:]} x {n_new} clients"
+                )
+        # Validate the state pairing BEFORE touching any array: a grow
+        # that raises must leave the store exactly as it found it.
+        if self.state is not None and state_rows is None:
+            raise ValueError(
+                "store carries per-client state; grow() needs "
+                "state_rows for the joined clients"
+            )
+        if self.state is None and state_rows is not None and (
+            tree_leaves_np(state_rows)
+        ):
+            raise ValueError("grow() got state_rows on a stateless store")
+        first = self.n_clients
+        need = first + n_new
+
+        def grow_one(key, cur, new_rows):
+            """Capacity-doubled append for ONE grown array — the single
+            growth mechanism every array goes through (data shards,
+            state-tree leaves, the valuation vector): a stateful
+            million-client run with joins every round must not re-copy
+            any full-N array per round."""
+            cur = np.asarray(cur)
+            new_rows = np.asarray(new_rows)
+            buf = self._grow_backing.get(key)
+            if buf is None or need > buf.shape[0] or (
+                buf.dtype != cur.dtype or buf.shape[1:] != cur.shape[1:]
+            ):
+                buf = np.empty(
+                    (max(2 * cur.shape[0], need),) + cur.shape[1:],
+                    cur.dtype,
+                )
+                buf[: cur.shape[0]] = cur
+                self._grow_backing[key] = buf
+            elif cur.base is not buf:
+                # The array was replaced since the last grow
+                # (attach_valuation/attach_state on resume, a whole-tree
+                # scatter): refresh the resident rows, or the view below
+                # would resurrect stale pre-replacement values.
+                buf[: cur.shape[0]] = cur
+            buf[first:need] = new_rows.astype(buf.dtype, copy=False)
+            return buf[:need]
+
+        for name, new_rows in rows.items():
+            setattr(self, name, grow_one((name,), getattr(self, name),
+                                         new_rows))
+        if self.state is not None:
+            counter = iter(range(1_000_000))
+            # tree_map_np traverses deterministically, so leaf position
+            # is a stable backing key across grows.
+            self.state = tree_map_np(
+                lambda a, r: grow_one(
+                    ("state", next(counter)), a, r
+                ),
+                self.state, state_rows,
+            )
+        if self.valuation is not None:
+            self.valuation = grow_one(
+                ("valuation",), self.valuation,
+                np.zeros(n_new, dtype=np.float64),
+            )
+        return first
 
     def attach_valuation(self, values) -> None:
         """Adopt the per-client valuation vector (telemetry/valuation.py)

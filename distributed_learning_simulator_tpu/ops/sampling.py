@@ -150,8 +150,29 @@ def _hashed_block_np(k0: np.uint32, k1: np.uint32, start: int, size: int,
     return vals
 
 
+def _check_alive(alive, n: int, k: int):
+    """Normalize/validate an alive mask for the masked hashed draw
+    (population='dynamic', robustness/population.py): bool[n], with at
+    least k alive indices — fewer could never fill a cohort and the
+    first-k-distinct loop would spin forever."""
+    alive = np.asarray(alive, dtype=bool)
+    if alive.shape != (n,):
+        raise ValueError(
+            f"alive mask has shape {alive.shape}, expected ({n},)"
+        )
+    n_alive = int(alive.sum())
+    if n_alive < k:
+        raise ValueError(
+            f"cannot draw a {k}-client cohort from {n_alive} alive "
+            f"clients (population {n}); departures must leave at least "
+            "the cohort size alive (robustness/population.py caps them)"
+        )
+    return alive
+
+
 def hashed_cohort_np(key_words, n: int, k: int,
-                     block: int | None = None) -> np.ndarray:
+                     block: int | None = None,
+                     alive=None) -> np.ndarray:
     """Numpy mirror of the hashed draw: first k distinct stream values.
 
     ``key_words`` is the uint32[>=2] key-data array
@@ -160,9 +181,22 @@ def hashed_cohort_np(key_words, n: int, k: int,
     (``Algorithm.cohort_indices``) runs THIS, not the jitted loop,
     because at cohort=256 the draw is a few microseconds of numpy and
     must never cost a device round-trip.
+
+    ``alive`` (optional bool[n]) masks indices out of the stream — a
+    DEPARTED client (population='dynamic') is rejected exactly like a
+    modulo-bias value, so the cohort is the first k distinct ALIVE
+    stream values and a departed index can never be resampled. With an
+    all-True mask the selection is identical to the unmasked draw (the
+    static-until-first-event bit-identity contract); the jitted
+    :func:`hashed_cohort` applies the same rejection, so the two
+    backends stay element-for-element equal by construction. A mostly-
+    dead population only costs extra rejection loop iterations, never a
+    different selection.
     """
     if not 0 < k <= n:
         raise ValueError(f"need 0 < k <= n, got k={k}, n={n}")
+    if alive is not None:
+        alive = _check_alive(alive, n, k)
     kw = np.asarray(key_words).ravel()
     k0, k1 = np.uint32(kw[0]), np.uint32(kw[1])
     size = block or overdraw_block(k, n)
@@ -172,6 +206,14 @@ def hashed_cohort_np(key_words, n: int, k: int,
     while count < k:
         vals = _hashed_block_np(k0, k1, start, size, n)
         start += size
+        if alive is not None:
+            # Departed indices are rejected like modulo-bias values (the
+            # -1 sentinel); np.where keeps the -1 rows out of the fancy
+            # index.
+            vals = np.where(
+                (vals >= 0) & alive[np.where(vals >= 0, vals, 0)],
+                vals, -1,
+            )
         # First occurrence within the block, in stream order...
         _, first = np.unique(vals, return_index=True)
         keep = np.zeros(vals.size, dtype=bool)
@@ -186,14 +228,28 @@ def hashed_cohort_np(key_words, n: int, k: int,
     return out
 
 
-def hashed_cohort(part_key, n: int, k: int, block: int | None = None):
+def hashed_cohort(part_key, n: int, k: int, block: int | None = None,
+                  alive=None):
     """Jitted hashed draw: int32[k] cohort, identical to the numpy
     mirror element-for-element (same stream, same first-k-distinct
     selection; the fixed-shape ``lax.while_loop`` only changes where
-    the rejection runs, never what is selected)."""
+    the rejection runs, never what is selected). ``alive`` (optional
+    bool[n] — may be a traced operand) rejects departed indices exactly
+    like :func:`hashed_cohort_np` does, so the masked draw keeps the
+    jit==numpy equality contract."""
     if not 0 < k <= n:
         raise ValueError(f"need 0 < k <= n, got k={k}, n={n}")
     k0, k1 = _key_words(part_key)
+    alive_j = None
+    if alive is not None:
+        if not isinstance(alive, jax.core.Tracer):
+            # Concrete masks get the same feasibility check as the
+            # numpy mirror: with fewer than k alive indices the
+            # fixed-shape while_loop's `count < k` condition could
+            # never flip and the program would spin forever on device —
+            # raise here instead.
+            _check_alive(np.asarray(alive), n, k)
+        alive_j = jnp.asarray(alive, bool)
     size = block or overdraw_block(k, n)
     arange_b = jnp.arange(size, dtype=jnp.uint32)
     zeros_b = jnp.zeros(size, jnp.uint32)
@@ -214,6 +270,13 @@ def hashed_cohort(part_key, n: int, k: int, block: int | None = None):
             # trace-time gate drops the compare entirely when n divides
             # 2^32).
             vals = jnp.where(v0 < jnp.uint32(limit), vals, -1)
+        if alive_j is not None:
+            # Departed-index rejection (population='dynamic'), the same
+            # sentinel the numpy mirror uses; the clip keeps the -1
+            # sentinel rows from indexing out of bounds.
+            vals = jnp.where(
+                (vals >= 0) & alive_j[jnp.clip(vals, 0)], vals, -1
+            )
         # Stream-order first occurrence within the block: a value is a
         # duplicate if an EARLIER position holds it (strict lower
         # triangle of the equality matrix — O(B^2) compares on a small
@@ -240,20 +303,29 @@ def hashed_cohort(part_key, n: int, k: int, block: int | None = None):
 
 
 def draw_cohort(part_key, n_clients: int, n_participants: int,
-                sampler: str = "exact"):
+                sampler: str = "exact", alive=None):
     """In-program cohort draw — the one entry the round program traces.
 
     ``exact`` is byte-for-byte the pre-feature
     ``jax.random.choice(replace=False)`` (the bit-identity pin);
     ``hashed`` is the O(cohort) keyed-hash draw. Both return the
     cohort's true client ids with a leading axis of ``n_participants``.
+    ``alive`` (hashed only — config.validate() pins the pairing) masks
+    departed indices out of the stream (population='dynamic').
     """
     if sampler == "exact":
+        if alive is not None:
+            raise ValueError(
+                "participation_sampler='exact' cannot compose an alive "
+                "mask: the permutation draw has no maskable stream; use "
+                "'hashed' for dynamic populations"
+            )
         return jax.random.choice(
             part_key, n_clients, (n_participants,), replace=False
         )
     if sampler == "hashed":
-        return hashed_cohort(part_key, n_clients, n_participants)
+        return hashed_cohort(part_key, n_clients, n_participants,
+                             alive=alive)
     raise ValueError(
         f"unknown participation_sampler {sampler!r}; known: "
         + ", ".join(SAMPLERS)
@@ -262,7 +334,7 @@ def draw_cohort(part_key, n_clients: int, n_participants: int,
 
 def draw_cohort_host(part_key, n_clients: int, n_participants: int,
                      sampler: str = "exact", *,
-                     key_words=None) -> np.ndarray:
+                     key_words=None, alive=None) -> np.ndarray:
     """Host replay of :func:`draw_cohort` (``Algorithm.cohort_indices``)
     — the ONE host entry for both modes.
 
@@ -279,6 +351,12 @@ def draw_cohort_host(part_key, n_clients: int, n_participants: int,
     may then be None).
     """
     if sampler == "exact":
+        if alive is not None:
+            raise ValueError(
+                "participation_sampler='exact' cannot compose an alive "
+                "mask: the permutation draw has no maskable stream; use "
+                "'hashed' for dynamic populations"
+            )
         return np.asarray(
             jax.random.choice(
                 part_key, n_clients, (n_participants,), replace=False
@@ -287,7 +365,8 @@ def draw_cohort_host(part_key, n_clients: int, n_participants: int,
     if sampler == "hashed":
         if key_words is None:
             key_words = np.asarray(jax.random.key_data(part_key)).ravel()
-        return hashed_cohort_np(key_words, n_clients, n_participants)
+        return hashed_cohort_np(key_words, n_clients, n_participants,
+                                alive=alive)
     raise ValueError(
         f"unknown participation_sampler {sampler!r}; known: "
         + ", ".join(SAMPLERS)

@@ -140,17 +140,26 @@ class CohortStreamer:
         return jax.device_put(a)
 
     # ---- cohort replay -----------------------------------------------------
-    def cohort_for(self, round_key):
+    def cohort_for(self, round_key, n=None, alive=None, k=None):
         """Host replay of the cohort the round program draws from
         ``round_key`` (Algorithm.cohort_indices contract): a host numpy
         index array, or None when the cohort is the whole population.
         Timed: the draw cost (the exact replay's O(N log N) permutation
         vs the hashed mode's O(cohort) hash — ops/sampling.py) lands in
-        the next acquire's ``sample_ms`` and the ``sample`` phase."""
+        the next acquire's ``sample_ms`` and the ``sample`` phase.
+
+        ``n``/``alive``/``k`` serve ``population='dynamic'``
+        (robustness/population.py): the draw covers the CURRENT
+        registered index space with departed indices masked out, at the
+        pinned startup cohort size — defaults keep the static replay
+        byte-for-byte."""
         t0 = time.perf_counter()
         if self._cpu is not None:
             round_key = jax.device_put(round_key, self._cpu)
-        idx = self._algorithm.cohort_indices(round_key, self._n)
+        idx = self._algorithm.cohort_indices(
+            round_key, self._n if n is None else n,
+            alive=alive, n_participants=k,
+        )
         dt = time.perf_counter() - t0
         self._sample_pending += dt
         self.last_sample_seconds = dt

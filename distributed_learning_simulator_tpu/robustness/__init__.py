@@ -6,9 +6,11 @@ reports back deadlocks the server's blocking barrier forever
 repo's existing defenses (robust aggregation rules, atomic checkpoints)
 were missing: an injectable per-round client failure model
 (:mod:`.faults`), a deterministic crash-injection hook for the chaos
-harness (:mod:`.chaos`), and the asynchronous-federation subsystem —
+harness (:mod:`.chaos`), the asynchronous-federation subsystem —
 device-side arrival model, deadline rounds, staleness buffer
-(:mod:`.arrivals`).
+(:mod:`.arrivals`) — and the open-world dynamic-population layer:
+a round-key-chained registration stream of client joins, departures,
+and drifting data quality (:mod:`.population`).
 """
 
 from distributed_learning_simulator_tpu.robustness.arrivals import (  # noqa: F401
@@ -22,4 +24,8 @@ from distributed_learning_simulator_tpu.robustness.chaos import (  # noqa: F401
 from distributed_learning_simulator_tpu.robustness.faults import (  # noqa: F401
     FailureModel,
     all_finite,
+)
+from distributed_learning_simulator_tpu.robustness.population import (  # noqa: F401
+    PopulationEvents,
+    PopulationModel,
 )

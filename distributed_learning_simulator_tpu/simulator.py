@@ -67,6 +67,10 @@ from distributed_learning_simulator_tpu.robustness.arrivals import (
     AsyncFederation,
 )
 from distributed_learning_simulator_tpu.robustness.chaos import maybe_crash
+from distributed_learning_simulator_tpu.robustness.population import (
+    PopulationModel,
+    pop_key_words,
+)
 from distributed_learning_simulator_tpu.telemetry import (
     ClientStats,
     ClientValuation,
@@ -370,7 +374,8 @@ class _StackedAuxRow(Mapping):
 
 
 def _algo_checkpoint_state(algorithm, metrics, server_state,
-                           async_state=None, valuation=None) -> dict:
+                           async_state=None, valuation=None,
+                           population=None) -> dict:
     """Assemble the checkpoint's ``algo_state`` dict — the ONE copy shared
     by the round-loop checkpoint cadence, the batched-dispatch flush, and
     the SIGTERM force-write path (the copies were one field away from
@@ -379,7 +384,11 @@ def _algo_checkpoint_state(algorithm, metrics, server_state,
     buffer bit-exactly, absent entirely for synchronous runs.
     ``valuation`` is the streaming per-client valuation vector
     (telemetry/valuation.py) — persisted so a resumed run keeps its
-    accumulated contribution evidence; absent when the feature is off."""
+    accumulated contribution evidence; absent when the feature is off.
+    ``population`` is the dynamic-population registration-stream payload
+    (robustness/population.PopulationModel.checkpoint_state: cursor +
+    alive mask + joined shard rows) — what makes a resume mid-growth
+    stitch bit-identically; absent for static populations."""
     algo_state = {"prev_metrics": metrics}
     if hasattr(algorithm, "shapley_values"):
         algo_state["shapley_values"] = algorithm.shapley_values
@@ -389,6 +398,8 @@ def _algo_checkpoint_state(algorithm, metrics, server_state,
         algo_state["async_state"] = jax.device_get(async_state)
     if valuation is not None:
         algo_state["valuation"] = np.asarray(valuation)
+    if population is not None:
+        algo_state["population"] = population
     return algo_state
 
 
@@ -658,6 +669,23 @@ def run_simulation(
     # the resident program shape (HBM already sizes by the cohort).
     stream_sampled = streamed and cohort_n < n_clients
     stream_full = streamed and not stream_sampled
+    # Open-world population (config.population; robustness/population.py):
+    # None at the 'static' default — the exact pre-feature path. Under
+    # 'dynamic' the registration stream owns joins/departures/drift; the
+    # cohort stays PINNED at this startup population's sampled size
+    # (cohort_n), so the compiled round program never changes shape
+    # while N grows. config.validate() already pinned the composition
+    # (streamed + hashed + sampled + FedAvg family).
+    pop = PopulationModel.from_config(
+        config, n_clients, cohort_n, dataset=dataset
+    )
+    if pop is not None and not stream_sampled:
+        raise ValueError(
+            "population='dynamic' needs a sampled streamed cohort "
+            f"(cohort {cohort_n} of {n_clients} clients is the whole "
+            "population at this worker_number); raise worker_number or "
+            "lower participation_fraction"
+        )
     _assert_residency_feasible(
         config, global_params, n_clients,
         client_data.x.nbytes + client_data.y.nbytes
@@ -780,6 +808,10 @@ def run_simulation(
     # placement, once the ValuationState — and, under streamed
     # residency, its host-store home — exists).
     resumed_valuation = None
+    # Dynamic-population registration-stream state saved by an earlier
+    # run (applied after placement: it grows the host store by the
+    # checkpointed joined shards and restores the alive mask + cursor).
+    resumed_population = None
     key = jax.random.key(config.seed + 1)
     if streamed:
         # Host-side init: the full-N state tree must never be built as a
@@ -906,6 +938,22 @@ def run_simulation(
                     ckpt["algo_state"].get("shapley_values", {})
                 )
             resumed_valuation = ckpt["algo_state"].get("valuation")
+            resumed_population = ckpt["algo_state"].get("population")
+            if pop is not None and resumed_population is None:
+                raise ValueError(
+                    "population='dynamic' but the checkpoint has no "
+                    "registration-stream state (written with "
+                    "population='static'); resume with the configuration "
+                    "the checkpoint was written with"
+                )
+            if pop is None and resumed_population is not None:
+                raise ValueError(
+                    "checkpoint was written with population='dynamic' "
+                    "but population='static' now (the grown population "
+                    "and alive mask would be silently discarded); resume "
+                    "with the configuration the checkpoint was written "
+                    "with"
+                )
             logger.info("resumed from %s at round %d", ckpt_path, start_round)
         else:
             resumed_basename = ""
@@ -963,11 +1011,42 @@ def run_simulation(
         # under a mesh it uploads each cohort slice directly into the
         # client-axis PartitionSpec layout. config.validate() already
         # refused multihost + threaded.
-        store = HostShardStore(
-            client_data.x, client_data.y, client_data.mask,
-            client_data.sizes,
-            state=client_state if stream_sampled else None,
+        # Dynamic populations mutate label rows in place (drift) and the
+        # store normally ALIASES the caller's packed arrays
+        # (ascontiguousarray is zero-copy on contiguous input) — take
+        # ownership of the label array up front so a caller-shared
+        # client_data (bench legs, library callers) is never corrupted
+        # as a side effect. Labels only: x/mask/sizes are never mutated
+        # (growth appends into separate backing buffers).
+        _pop_y = (
+            np.array(client_data.y, copy=True) if pop is not None
+            else client_data.y
         )
+        if pop is not None and resumed_population is not None:
+            # Resume mid-growth: the store starts at the startup
+            # population (re-derived from the dataset partition), the
+            # registration state grows it by the checkpointed joined
+            # shards, and the (possibly grown) per-client state attaches
+            # afterwards — lengths then agree by construction.
+            store = HostShardStore(
+                client_data.x, _pop_y, client_data.mask,
+                client_data.sizes, state=None,
+            )
+            pop.restore(resumed_population, store)
+            if stream_sampled and client_state is not None:
+                store.attach_state(client_state)
+            logger.info(
+                "population resumed at cursor %d: %d registered, %d "
+                "alive (%d joined, %d departed)",
+                pop.cursor, pop.n_registered, int(pop.alive.sum()),
+                pop.totals["joins"], pop.totals["departs"],
+            )
+        else:
+            store = HostShardStore(
+                client_data.x, _pop_y, client_data.mask,
+                client_data.sizes,
+                state=client_state if stream_sampled else None,
+            )
         streamer = CohortStreamer(store, algorithm, n_clients, mesh=mesh)
         if stream_full:
             (cx, cy, cmask, sizes, _full_idx), startup_stream["rec"] = (
@@ -1067,6 +1146,7 @@ def run_simulation(
         config.pipeline_rounds
         and not batched
         and not stream_stateful
+        and pop is None
         and algorithm.supports_round_pipelining
         and not (
             checkpointing
@@ -1080,6 +1160,12 @@ def run_simulation(
             reason = (
                 "rounds_per_dispatch > 1 already amortizes the fetch "
                 "(one device_get per dispatch)"
+            )
+        elif pop is not None:
+            reason = (
+                "population='dynamic' registration events mutate host "
+                "population state at every round boundary; a deferred "
+                "finalize would checkpoint the wrong stream cursor"
             )
         elif stream_stateful:
             reason = (
@@ -1124,6 +1210,19 @@ def run_simulation(
     # the schema-v3 record. None at the default 'off'.
     client_stats_cfg = ClientStats.from_config(config)
     telemetry["clients_flagged"] = 0
+    # Dynamic population (robustness/population.py): rounds rejected by
+    # the quorum policy where the round ALSO lost cohort members to
+    # departures — the churn-collision telemetry the records flag as
+    # rejected_by_churn.
+    telemetry["churn_rejected"] = 0
+    # One-row per-client state proto for joiners (stateful streamed
+    # runs: reset_client_optimizer=False): replicated per joined client
+    # by PopulationModel.apply. None for the stateless default.
+    pop_state_proto = None
+    if pop is not None and store is not None and store.state is not None:
+        pop_state_proto = _host_client_state(
+            algorithm, optimizer, global_params, 1
+        )
     # Always-on client valuation (telemetry/valuation.py): the round
     # program emits a per-cohort streaming score vector (riding the
     # client-stats machinery); the host scales it by the server
@@ -1140,7 +1239,14 @@ def run_simulation(
     auditor = None
     telemetry["valuation_last_audit"] = None
     if valuation_cfg is not None:
-        vstate = ValuationState(n_clients, store=store)
+        # Population-indexed: sized by the (possibly resumed-grown)
+        # store under streamed residency so valued ids stay TRUE indices
+        # across dynamic-population growth; the vector keeps growing
+        # with the store (HostShardStore.grow appends zeros).
+        vstate = ValuationState(
+            store.n_clients if store is not None else n_clients,
+            store=store,
+        )
         if resumed_valuation is not None:
             vstate.load(resumed_valuation)
         elif start_round > 0:
@@ -1178,7 +1284,7 @@ def run_simulation(
 
     def emit_record(round_idx, metrics, fetched_loss, fetched_tel, ctx,
                     tel_rec_fn, phase_round=None, stream_rec=None,
-                    audit_fn=None):
+                    audit_fn=None, population_rec=None):
         """Build + persist ONE round's metrics record from already-fetched
         host values: post_round hook, record assembly, quorum/cohort
         telemetry accumulation, client-stats detection, history append +
@@ -1329,15 +1435,29 @@ def run_simulation(
                 run_rounds=config.round,
             )
             telemetry["costmodel"] = cm_rec
+        pop_rec = None
+        if population_rec is not None:
+            # The churn-collision flag needs the round's quorum verdict,
+            # known only here: rejected AND cohort members departed this
+            # round (robustness/population.py, the PR 2 contract's
+            # open-world face).
+            pop_rec = dict(population_rec)
+            pop_rec["rejected_by_churn"] = bool(
+                record.get("round_rejected")
+                and pop_rec.get("cohort_departs", 0) > 0
+            )
+            if pop_rec["rejected_by_churn"]:
+                telemetry["churn_rejected"] += 1
         tel_rec = tel_rec_fn()
         if (
             tel_rec is not None or cs_rec is not None
             or async_rec is not None or stream_rec is not None
             or cm_rec is not None or val_rec is not None
+            or pop_rec is not None
         ):
             record = build_round_record(
                 record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec,
-                val_rec,
+                val_rec, population=pop_rec,
             )
         history.append(record)
         if metrics_path:
@@ -1454,6 +1574,7 @@ def run_simulation(
         emit_record(
             p["round_idx"], metrics, fetched_loss, fetched_tel, ctx,
             tel_rec_fn, stream_rec=p.get("stream"), audit_fn=audit_fn,
+            population_rec=p.get("population"),
         )
 
         if (
@@ -1469,6 +1590,12 @@ def run_simulation(
                     algorithm, metrics, p["server_state"],
                     p.get("async_state"),
                     vstate.values if vstate is not None else None,
+                    # Population events for this round were applied
+                    # before finalize (pipelining is off under dynamic),
+                    # so the snapshot is exactly the state the NEXT
+                    # round draws from.
+                    pop.checkpoint_state(store) if pop is not None
+                    else None,
                 ),
                 p["key"],
             )
@@ -1912,6 +2039,7 @@ def run_simulation(
                             if async_ctl is not None else {}
                         )
                         stream_rec = None
+                        pop_rec = None
                         if stream_sampled:
                             # Streamed dispatch: cohort slices arrive as
                             # pre-gathered operands (prefetched while the
@@ -1919,7 +2047,39 @@ def run_simulation(
                             # gathers from the host store (post the
                             # previous round's writeback) and scatters
                             # back after this dispatch.
-                            if stream_next_idx is not None:
+                            pop_events = pop_words = dep_mask = None
+                            if pop is not None:
+                                # Dynamic population: the cohort is
+                                # drawn from the PRE-event registered
+                                # index space (departed masked out of
+                                # the hashed stream); this round's
+                                # events come from the fold_in-decoupled
+                                # registration stream and APPLY after
+                                # the dispatch — a joiner is sampleable
+                                # from the next round, a departure that
+                                # hits this cohort rides the departed
+                                # operand. Drift levels advance before
+                                # the gather so sampled drifting
+                                # clients train on this round's labels.
+                                pop_words = pop_key_words(
+                                    round_key, pop.seed
+                                )
+                                with phase_timer.phase(
+                                        round_idx, "sample"):
+                                    idx_np = streamer.cohort_for(
+                                        round_key,
+                                        n=pop.n_registered,
+                                        alive=pop.alive,
+                                        k=cohort_n,
+                                    )
+                                pop_events = pop.draw_events(
+                                    pop_words, round_idx
+                                )
+                                dep_mask = pop.cohort_departed_mask(
+                                    pop_events, idx_np
+                                )
+                                pop.apply_drift(store, round_idx, idx_np)
+                            elif stream_next_idx is not None:
                                 idx_np = stream_next_idx
                             else:
                                 # First round / resume: the draw is not
@@ -1949,12 +2109,16 @@ def run_simulation(
                                     state_k = shard_client_data(
                                         state_k, mesh
                                     )
+                            dyn_kw = (
+                                {"departed": jnp.asarray(dep_mask)}
+                                if pop is not None else {}
+                            )
                             with phase_timer.phase(
                                     round_idx, "client_step") as _ph:
                                 new_global, new_state_k, aux = round_jit(
                                     global_params, state_k, sx, sy, sm,
                                     ssz, sidx, round_key,
-                                    *lr_args, **async_kw,
+                                    *lr_args, **async_kw, **dyn_kw,
                                 )
                                 # Prefetch the next round's cohort while
                                 # this dispatch computes (the upload runs
@@ -1964,9 +2128,14 @@ def run_simulation(
                                 # of this client_step window into the
                                 # `sample` phase so the ~1 s exact
                                 # replay at N=1e6 stays visible.
-                                if round_idx + 1 < config.round and not (
-                                    preempt["flag"]
-                                ):
+                                # Dynamic populations draw synchronously
+                                # instead: the next cohort depends on
+                                # this round's registration events
+                                # (applied below), and the O(cohort)
+                                # hashed draw is microseconds.
+                                if pop is None and (
+                                    round_idx + 1 < config.round
+                                ) and not preempt["flag"]:
                                     _, _nxt_rk = jax.random.split(key)
                                     stream_next_idx = streamer.cohort_for(
                                         _nxt_rk
@@ -1982,6 +2151,21 @@ def run_simulation(
                             # dispatches: checkpoint/resume read it.
                             streamer.writeback(idx_np, new_state_k,
                                                stream_rec)
+                            if pop is not None:
+                                # Registration events apply at the round
+                                # boundary, after the writeback and
+                                # before this round's checkpoint: the
+                                # persisted state is exactly what the
+                                # next round's draw sees.
+                                pop.apply(
+                                    pop_events, store,
+                                    state_proto=pop_state_proto,
+                                    words=pop_words,
+                                )
+                                pop_rec = pop.round_record(
+                                    pop_events,
+                                    int(np.count_nonzero(dep_mask)),
+                                )
                         else:
                             if (
                                 stream_full
@@ -2050,6 +2234,7 @@ def run_simulation(
                         "server_state": server_state,
                         "async_state": async_state,
                         "stream": stream_rec,
+                        "population": pop_rec,
                     }
                     global_params = new_global
                     if pipelined:
@@ -2109,6 +2294,8 @@ def run_simulation(
                     _algo_checkpoint_state(
                         algorithm, prev_metrics, server_state, async_state,
                         vstate.values if vstate is not None else None,
+                        pop.checkpoint_state(store) if pop is not None
+                        else None,
                     ),
                     key,
                 )
@@ -2236,6 +2423,16 @@ def run_simulation(
         # hit rate — None when the memo is off or no walk ran.
         "gtg_memo_hit_rate": getattr(
             algorithm, "gtg_memo_hit_rate", None
+        ),
+        # Open-world population (robustness/population.py): the
+        # registration stream's run summary — growth ratio, alive count,
+        # total joins/departs, and how many quorum rejections coincided
+        # with in-cohort departures (bench.py's churn leg reads these).
+        # "static" mode reports None, the off-gate convention.
+        "population": config.population,
+        "population_summary": (
+            pop.summary(telemetry["churn_rejected"])
+            if pop is not None else None
         ),
         "preempted_at": preempted_at,
     }

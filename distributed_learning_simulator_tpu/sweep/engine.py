@@ -237,6 +237,7 @@ def lean_supported(cfg) -> bool:
         and not cfg.multihost
         and (cfg.mesh_devices or 1) <= 1
         and cfg.client_residency.lower() == "resident"
+        and getattr(cfg, "population", "static").lower() == "static"
         and cfg.rounds_per_dispatch == 1
         and cfg.async_mode.lower() == "off"
         and cfg.client_stats.lower() == "off"

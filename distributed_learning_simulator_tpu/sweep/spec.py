@@ -305,6 +305,14 @@ class SweepSpec:
                 "async_mode='on' carries a staleness-buffer state tree "
                 "per experiment; the fleet does not stack it"
             )
+        if getattr(cfg, "population", "static").lower() != "static":
+            return False, (
+                "population='dynamic' grows the client axis mid-run "
+                "(robustness/population.py); experiment-axis stacking "
+                "assumes a fixed N shared by every point, so a vmapped "
+                "fleet cannot serve it — the scheduled strategy runs "
+                "each dynamic point through a full run_simulation"
+            )
         if cfg.client_residency.lower() != "resident":
             return False, (
                 "client_residency='streamed' pins the cohort pipeline to "

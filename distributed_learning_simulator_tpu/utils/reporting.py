@@ -34,17 +34,23 @@ import json
 # telemetry/valuation.py). v8 adds the ``sweep`` sub-object (which
 # sweep point a record belongs to, the execution strategy, the point's
 # config-hash group, and whether its program was reused warm;
-# sweep/engine.py). A record
+# sweep/engine.py). v9 adds the ``population`` sub-object (the
+# dynamic-population registration stream's per-round outcome: alive/
+# registered counts, joins, departures — total and in-cohort — the
+# planted drift cohort, and the rejected-by-churn flag;
+# robustness/population.py). A record
 # is stamped with the LOWEST version that describes it:
 # telemetry_level='off' keeps emitting v1 byte-for-byte,
 # client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
 # async_mode='off' keeps records at v3 or below, client_residency=
 # 'resident' keeps records at v4 or below, cost_model_trace=None
 # keeps records at v5 or below, client_valuation='off' keeps
-# records at v6 or below, and solo (non-sweep) runs keep records at v7
-# or below — longitudinal tooling never sees a
+# records at v6 or below, solo (non-sweep) runs keep records at v7
+# or below, and population='static' keeps records at v8 or below —
+# longitudinal tooling never sees a
 # layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 8
+METRICS_SCHEMA_VERSION = 9
+_SWEEP_SCHEMA_VERSION = 8
 _VALUATION_SCHEMA_VERSION = 7
 _COSTMODEL_SCHEMA_VERSION = 6
 _STREAM_SCHEMA_VERSION = 5
@@ -103,7 +109,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
                        stream: dict | None = None,
                        costmodel: dict | None = None,
                        valuation: dict | None = None,
-                       sweep: dict | None = None) -> dict:
+                       sweep: dict | None = None,
+                       population: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -123,17 +130,21 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     ``"costmodel"`` key; a valuation dict
     (telemetry/valuation.valuation_record) upgrades it to v7 under the
     ``"valuation"`` key; a sweep dict (sweep/engine.py per-point
-    provenance) upgrades it to v8 under the ``"sweep"`` key.
+    provenance) upgrades it to v8 under the ``"sweep"`` key; a
+    population dict (robustness/population.PopulationModel.round_record)
+    upgrades it to v9 under the ``"population"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
     ) and stream is None and costmodel is None and valuation is None and (
         sweep is None
-    ):
+    ) and population is None:
         return base
     record = dict(base)
-    if sweep is not None:
+    if population is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif sweep is not None:
+        record["schema_version"] = _SWEEP_SCHEMA_VERSION
     elif valuation is not None:
         record["schema_version"] = _VALUATION_SCHEMA_VERSION
     elif costmodel is not None:
@@ -160,6 +171,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["valuation"] = valuation
     if sweep is not None:
         record["sweep"] = sweep
+    if population is not None:
+        record["population"] = population
     return record
 
 
@@ -197,6 +210,15 @@ def config_hash(config) -> str:
         # ACTIVE sweep — which changes what the process runs — lands
         # its point list and strategy in the hash.
         for k in ("sweep_seeds", "sweep_points", "sweep_strategy"):
+            d.pop(k, None)
+    if (d.get("population") or "static").lower() == "static":
+        # 'static' IS the pre-feature fixed population (the round
+        # program and record stream are untouched), so pre-feature
+        # configs keep their pre-feature hash; 'dynamic' changes the
+        # program (the departed operand) and the drawn cohorts, and
+        # lands every population knob in the hash.
+        for k in ("population", "population_seed", "join_rate",
+                  "depart_rate", "drift_fraction", "drift_factor"):
             d.pop(k, None)
     blob = json.dumps(d, sort_keys=True, default=repr)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Open-world dynamic population (docs/ROBUSTNESS.md § Dynamic
+# populations): 100 clients grow toward ~10x over 30 rounds (20
+# registrations/round, shards drawn over the growing index space), 2% of
+# alive clients depart per round (masked out of the hashed sampler's
+# stream, never resampled; a same-round departure is quorum-visible),
+# and a planted 10-client cohort drifts toward graded label noise that
+# the always-on streaming valuation tracks. The cohort stays pinned at
+# 25 clients/round, so the compiled program never changes shape while N
+# grows. CRC-verified checkpoints persist the registration-stream
+# cursor + alive mask + grown shards: kill this run at any point and
+# --resume true stitches bit-identically (chaos proof:
+# tests/test_chaos_resume.py mid-growth variant).
+set -e
+python -m distributed_learning_simulator_tpu.simulator \
+  --dataset_name cifar10 --model_name cnn_tpu \
+  --distributed_algorithm fed \
+  --worker_number 100 --round 30 --epoch 1 --learning_rate 0.1 \
+  --momentum 0.9 --batch_size 25 --participation_fraction 0.25 \
+  --client_residency streamed --participation_sampler hashed \
+  --population dynamic --join_rate 20 --depart_rate 0.02 \
+  --drift_fraction 0.1 --drift_factor 0.8 \
+  --client_stats on --client_valuation on \
+  --min_survivors 5 \
+  --checkpoint_dir ckpt_population --checkpoint_every 5 \
+  --checkpoint_keep_last 3 \
+  --log_level INFO
+# Render the population section (N-over-time sparkline, join/depart
+# counts, drift overlay on the valuation tables) from the newest run:
+python scripts/report_run.py "$(ls -dt log/fed/cifar10/cnn_tpu/*_artifacts | head -1)"

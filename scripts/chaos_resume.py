@@ -43,6 +43,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # history record must match bit-for-bit.
 VOLATILE_KEYS = ("round_seconds",)
 
+# Wall-clock fields inside the schema-v5 ``stream`` sub-object
+# (client_residency='streamed' workloads, e.g. the dynamic-population
+# variant): transfer/draw TIMINGS differ run to run, while the byte
+# counts and sampler name must still match bit-for-bit.
+STREAM_VOLATILE_KEYS = (
+    "h2d_seconds", "hidden_seconds", "overlap_ratio", "sample_ms",
+    "d2h_seconds",
+)
+
 
 def _pin_platform():
     """Honor JAX_PLATFORMS even where a sitecustomize force-registers a
@@ -55,10 +64,16 @@ def _pin_platform():
 
 
 def strip_volatile(records: list[dict]) -> list[dict]:
-    return [
-        {k: v for k, v in r.items() if k not in VOLATILE_KEYS}
-        for r in records
-    ]
+    out = []
+    for r in records:
+        r = {k: v for k, v in r.items() if k not in VOLATILE_KEYS}
+        if isinstance(r.get("stream"), dict):
+            r["stream"] = {
+                k: v for k, v in r["stream"].items()
+                if k not in STREAM_VOLATILE_KEYS
+            }
+        out.append(r)
+    return out
 
 
 def normalize(records: list[dict]) -> list[dict]:

@@ -56,7 +56,12 @@ leg's ``sweep_amortization_ratio`` (serial-solo vs vmapped-fleet wall
 for the same points, sweep/engine.py) gets
 ``--sweep-amortization-threshold`` as an absolute floor, default 2.0 —
 the fleet must at least halve the sweep's wall-clock (compile paid
-once is the whole multiplier). The
+once is the whole multiplier). The ``churn`` leg's
+``churn_overhead_ratio`` (10x population-growth dynamic run vs the
+same program static, robustness/population.py) gets
+``--churn-overhead-threshold`` as an absolute ceiling, default 0.10 —
+the registration stream must ride the round at marginal cost, never
+relatively tracked. The
 ``costmodel`` leg's ``model_error_ratio`` per program (predicted /
 measured per-round ms from the roofline model, telemetry/costmodel.py)
 is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
@@ -339,6 +344,34 @@ def sweep_amortization_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def churn_overhead_gate(record: dict, threshold: float) -> dict | None:
+    """In-record open-world-churn gate: bench.py's ``churn`` leg runs a
+    10x population-growth ``population='dynamic'`` run against the same
+    program static (both streamed + hashed + sampled — the composition
+    dynamic populations require) and records ``churn_overhead_ratio``,
+    the dynamic-vs-static median round-time ratio minus one
+    (robustness/population.py). A ratio above ``threshold`` means the
+    registration stream (masked draw, event draws, store growth, drift
+    mutation, synchronous gather) stopped riding the round at marginal
+    cost — a regression regardless of the old record. Judged ABSOLUTELY
+    (the PR 4 overhead-gate precedent: the ratio sits near a fixed small
+    operating point, where a relative gate would flap). None when the
+    leg is absent or the ceiling holds."""
+    ratio = get_path(record, "churn.churn_overhead_ratio")
+    if ratio is None or ratio <= threshold:
+        return None
+    return {
+        "metric": "churn.churn_overhead_ratio",
+        "description": (
+            "round-time overhead of the 10x-growth dynamic-population "
+            "run vs the same program static (registration stream must "
+            "ride the round at marginal cost)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "lower",
+    }
+
+
 def model_drift_gate(record: dict, threshold: float) -> list[dict]:
     """In-record cost-model drift gate: bench.py's ``costmodel`` leg
     records, per proxied program, the roofline model's predicted-vs-
@@ -433,6 +466,12 @@ def main(argv: list[str] | None = None) -> int:
                          "must keep tracking exact Shapley on the "
                          "differential config; measured operating point "
                          "~0.85-0.9)")
+    ap.add_argument("--churn-overhead-threshold", type=float, default=0.10,
+                    help="max tolerated dynamic-vs-static round-time "
+                         "overhead ratio in the NEW record's churn leg "
+                         "(default 0.10 — the 10x population-growth "
+                         "registration stream must ride the round at "
+                         "marginal cost)")
     ap.add_argument("--model-drift-threshold", type=float, default=0.35,
                     help="max tolerated |model_error_ratio - 1| in the NEW "
                          "record's costmodel leg, per program (default "
@@ -468,6 +507,7 @@ def main(argv: list[str] | None = None) -> int:
         stream_cohort_rate_gate(new, args.stream_cohort_rate_threshold),
         sweep_amortization_gate(new, args.sweep_amortization_threshold),
         valuation_corr_gate(new, args.valuation_corr_threshold),
+        churn_overhead_gate(new, args.churn_overhead_threshold),
     ):
         if gate is not None:
             result["regressions"].append(gate)

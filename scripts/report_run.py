@@ -39,7 +39,11 @@ latest GTG audit-correlation line; telemetry/valuation.py), and v8
 (``sweep`` sub-object — rendered as the sweep section: per-point
 accuracy table, winner line, compile-reuse summary, and — when a trace
 is attached (``--trace``) — the cost model's $/sweep row per topology;
-sweep/engine.py). The only
+sweep/engine.py), and v9 (``population`` sub-object — rendered as the
+dynamic-population section: alive-N-over-time sparkline, per-round
+join/depart counts, churn-rejected rounds, and the planted
+drift-cohort overlay against the v7 valuation top/bottom tables;
+robustness/population.py). The only
 heavy import (jax, via utils.tracing) is deferred behind ``--trace``,
 so metrics-only reporting is instant.
 """
@@ -314,6 +318,57 @@ def summarize_sweep(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_population(records: list[dict]) -> dict | None:
+    """Aggregate schema-v9 ``population`` sub-objects into the
+    open-world summary: the N-over-time curves, per-round join/depart
+    counts, the planted drift cohort, and churn-rejected rounds
+    (robustness/population.py). None when no record carries population
+    data."""
+    pops = [
+        (r.get("round"), r["population"]) for r in records
+        if isinstance(r.get("population"), dict)
+    ]
+    if not pops:
+        return None
+    timeline = [
+        {"round": rnd, "n_alive": p.get("n_alive"),
+         "n_registered": p.get("n_registered"),
+         "joins": p.get("joins", 0), "departs": p.get("departs", 0)}
+        for rnd, p in pops
+    ]
+    first_p = pops[0][1]
+    last_p = pops[-1][1]
+    # Every record carries the run's startup population; the derivation
+    # fallback (first record's post-event count minus its joins) only
+    # serves files written before n_initial landed, and is wrong for
+    # partial files that don't start at round 0.
+    n_initial = first_p.get(
+        "n_initial",
+        first_p.get("n_registered", 0) - first_p.get("joins", 0),
+    )
+    drift_ids = sorted({
+        int(c) for _, p in pops for c in p.get("drift_clients", [])
+    })
+    return {
+        "rounds_reported": len(pops),
+        "n_initial": n_initial,
+        "n_registered_final": last_p.get("n_registered"),
+        "n_alive_final": last_p.get("n_alive"),
+        "joins_total": sum(t["joins"] for t in timeline),
+        "departs_total": sum(t["departs"] for t in timeline),
+        "growth_ratio": (
+            round(last_p["n_registered"] / n_initial, 4)
+            if n_initial else None
+        ),
+        "timeline": timeline,
+        "drift_cohort_size": last_p.get("drift_cohort_size", 0),
+        "drift_clients": drift_ids,
+        "churn_rejected_rounds": [
+            rnd for rnd, p in pops if p.get("rejected_by_churn")
+        ],
+    }
+
+
 def summarize_run(records: list[dict], trace_stats: dict | None = None,
                   top_ops: list[dict] | None = None,
                   top_ops_time: list[dict] | None = None,
@@ -467,6 +522,27 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     sweep_summary = summarize_sweep(records)
     if sweep_summary is not None:
         summary["sweep"] = sweep_summary
+
+    # --- population sub-objects (schema v9, population='dynamic') -----------
+    pop_summary = summarize_population(records)
+    if pop_summary is not None:
+        summary["population"] = pop_summary
+        if valuation is not None and pop_summary["drift_clients"]:
+            # Drift-cohort overlay on the PR 9 valuation tables: the
+            # planted drifting clients SHOULD sink into the bottom-k
+            # ranking; one surfacing in the top-k is the surprising
+            # disagreement worth a look (the flagged-overlay pattern).
+            drift = set(pop_summary["drift_clients"])
+            valuation["drift_overlay"] = {
+                "drift_in_bottom": [
+                    e["id"] for e in valuation["bottom_clients"]
+                    if e["id"] in drift
+                ],
+                "drift_in_top": [
+                    e["id"] for e in valuation["top_clients"]
+                    if e["id"] in drift
+                ],
+            }
 
     # --- costmodel sub-object (schema v6, cost_model_trace) -----------------
     # Explicit costmodel (computed live from --trace) wins; otherwise the
@@ -654,6 +730,22 @@ def render_summary(summary: dict) -> list[str]:
                 f"  !! flagged client {o['id']}: valuation {val} "
                 f"(rank {o['rank']}/{v['n_clients']}, 0 = most valuable)"
             )
+        ov = v.get("drift_overlay")
+        if ov:
+            # Planted drifting-quality cohort (population='dynamic')
+            # against the valuation ranking: sinking into the bottom-k
+            # is the expected direction; a drifting client in the top-k
+            # is the disagreement worth a look.
+            lines.append(
+                f"  drift overlay: {len(ov['drift_in_bottom'])}/"
+                f"{len(v['bottom_clients'])} of bottom clients are "
+                f"planted drifters"
+                + (
+                    f"; !! drifters in TOP clients: "
+                    f"{ov['drift_in_top']}"
+                    if ov["drift_in_top"] else ""
+                )
+            )
         if v["last_audit"] is not None:
             a = v["last_audit"]
             hit = (
@@ -670,6 +762,53 @@ def render_summary(summary: dict) -> list[str]:
                     "n/a" if pe is None else f"{pe:.3f}",
                     a["permutations"], a["converged"], hit,
                 )
+            )
+
+    if "population" in summary:
+        p = summary["population"]
+        lines.append(
+            f"dynamic population: {p['n_initial']} -> "
+            f"{p['n_registered_final']} registered clients "
+            f"({p['joins_total']} joined, {p['departs_total']} departed, "
+            f"{p['n_alive_final']} alive"
+            + (
+                f", growth {p['growth_ratio']:.2f}x"
+                if p["growth_ratio"] is not None else ""
+            )
+            + ")"
+        )
+        alive_curve = [
+            t["n_alive"] for t in p["timeline"]
+            if t["n_alive"] is not None
+        ]
+        if alive_curve:
+            lines.append(
+                f"  alive N over time: {sparkline(alive_curve)}  "
+                f"[{min(alive_curve)} .. {max(alive_curve)}]"
+            )
+        joins = [t["joins"] for t in p["timeline"]]
+        departs = [t["departs"] for t in p["timeline"]]
+        if any(joins):
+            lines.append(
+                f"  joins/round:   {sparkline(joins)}  "
+                f"(total {sum(joins)})"
+            )
+        if any(departs):
+            lines.append(
+                f"  departs/round: {sparkline(departs)}  "
+                f"(total {sum(departs)})"
+            )
+        if p["drift_cohort_size"]:
+            ids = p["drift_clients"]
+            lines.append(
+                f"  planted drift cohort: {p['drift_cohort_size']} "
+                "client(s)"
+                + (f" {ids}" if ids else "")
+            )
+        if p["churn_rejected_rounds"]:
+            lines.append(
+                "  !! rounds rejected by churn (departures pushed "
+                f"survivors below quorum): {p['churn_rejected_rounds']}"
             )
 
     if "async_federation" in summary:

@@ -120,6 +120,58 @@ def test_sigterm_grace_checkpoint_and_resume(tmp_path):
     assert verdict["bit_identical"], verdict
 
 
+def test_subprocess_sigkill_mid_growth_resume_bit_identical(tmp_path):
+    """Dynamic-population chaos variant (ISSUE 13): SIGKILL between
+    checkpoints DURING active joins/departures/drift, resume, and the
+    stitched history must be bit-identical to the uninterrupted dynamic
+    run — the registration-stream cursor, alive mask, and grown shard
+    store restored from the checkpoint, and the round past the newest
+    checkpoint replayed (its events re-drawn from the restored key
+    chain)."""
+    dyn = dict(
+        population="dynamic", join_rate=2.0, depart_rate=0.1,
+        drift_fraction=0.5, drift_factor=0.8,
+        participation_sampler="hashed", client_residency="streamed",
+        min_survivors=1,
+        # The chaos workload's dropout faults compose with churn; keep
+        # them (the stitched comparison then covers fault draws, the
+        # masked cohort stream, registration events, and drift at once).
+    )
+    straight = chaos.normalize(
+        run_simulation(_chaos_config(tmp_path, "straight_dyn", **dyn))[
+            "history"
+        ]
+    )
+    assert any(r["population"]["joins"] for r in straight), (
+        "workload drew no joins — the variant would not cover growth"
+    )
+    # checkpoint_every=2 with the kill at round 2: resume restores the
+    # round-1 checkpoint (population cursor=1) and must bit-exactly
+    # REPLAY round 2's events before continuing.
+    cfg = _chaos_config(
+        tmp_path, "sigkill_dyn",
+        checkpoint_dir=str(tmp_path / "sigkill_dyn" / "ckpt"),
+        checkpoint_every=2, **dyn,
+    )
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, "--child",
+         "--config", json.dumps(vars(cfg))],
+        env={**_child_env(), "DLS_CRASH_AT_ROUND": "2",
+             "DLS_CRASH_KIND": "sigkill"},
+        capture_output=True, text=True, timeout=420,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr)
+    crashed = chaos.read_metrics_jsonl(cfg.log_root)
+    assert crashed, "SIGKILLed dynamic run flushed no metrics records"
+    resumed = chaos.run_resumed(cfg)
+    verdict = chaos.stitch_and_compare(straight, crashed, resumed)
+    assert verdict["bit_identical"], verdict
+    # The comparison really covered churn: records carry the v9
+    # population sub-object and the run grew.
+    assert straight[-1]["population"]["n_registered"] > 6
+
+
 def test_cohort_sampling_resume_determinism(tiny_config, tmp_path):
     """With participation_fraction < 1 and no failure model, the per-round
     sampled cohorts after resume must match the uninterrupted run — the

@@ -389,6 +389,53 @@ def test_sweep_amortization_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_churn_overhead_not_relatively_tracked(cb):
+    """The dynamic-vs-static round-time overhead sits near a fixed small
+    operating point — like every other in-record ratio it must never be
+    a relative TRACKED metric; only the absolute ceiling judges it."""
+    old = _record(churn={"churn_overhead_ratio": 0.01})
+    new = _record(churn={"churn_overhead_ratio": 0.06})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "churn" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_churn_overhead_self_gate(cb, tmp_path):
+    """In-record absolute ceiling: a registration stream that stops
+    riding the round at marginal cost (10x-growth overhead above the
+    ceiling) gates on the NEW record alone."""
+    assert cb.churn_overhead_gate(_record(), 0.10) is None  # leg absent
+    ok = _record(churn={"churn_overhead_ratio": 0.04,
+                        "population": {"growth_ratio": 10.0}})
+    assert cb.churn_overhead_gate(ok, 0.10) is None
+    # A NEGATIVE ratio (dynamic measured faster — run noise) holds too.
+    assert cb.churn_overhead_gate(
+        _record(churn={"churn_overhead_ratio": -0.02}), 0.10
+    ) is None
+    bad = _record(churn={"churn_overhead_ratio": 0.31})
+    entry = cb.churn_overhead_gate(bad, 0.10)
+    assert entry and entry["new"] == 0.31 and entry["direction"] == "lower"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "churn.churn_overhead_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--churn-overhead-threshold", "0.5"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_model_drift_not_relatively_tracked(cb):
     """model_error_ratio sits near 1.0 — like the other in-record
     ratios it must never be a relative TRACKED metric (PR 4/5

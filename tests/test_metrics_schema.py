@@ -5,11 +5,11 @@ tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle, sweep engine) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
 (+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel), v7
-(+valuation) and v8 (+sweep) records must validate; records that mix
-versions and sub-objects inconsistently must not. The integration tests
-in test_client_stats.py (test_costmodel.py for v6, test_valuation.py
-for v7, test_sweep.py for v8) validate REAL produced records against
-the same file.
+(+valuation), v8 (+sweep) and v9 (+population) records must validate;
+records that mix versions and sub-objects inconsistently must not. The
+integration tests in test_client_stats.py (test_costmodel.py for v6,
+test_valuation.py for v7, test_sweep.py for v8, test_population.py for
+v9) validate REAL produced records against the same file.
 """
 
 import json
@@ -294,7 +294,7 @@ def test_v8_record_validates():
         _base(), _telemetry(), _client_stats(), _async(), _stream(),
         _costmodel(), _valuation(), _sweep(),
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 8
+    assert record["schema_version"] == 8
     validate(record)
     # sweep alone (every other feature off) is still v8 — the sweep
     # engine's per-point records at defaults.
@@ -311,8 +311,45 @@ def test_v8_record_validates():
     ))
 
 
+def _population() -> dict:
+    return {
+        "n_initial": 8,
+        "n_registered": 16,
+        "n_alive": 14,
+        "joins": 2,
+        "departs": 1,
+        "cohort_departs": 1,
+        "drift_cohort_size": 3,
+        "rejected_by_churn": False,
+        "drift_clients": [1, 4, 6],
+    }
+
+
+def test_v9_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async(), _stream(),
+        _costmodel(), _valuation(), _sweep(), _population(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 9
+    validate(record)
+    # population alone (every other feature off) is still v9 — a
+    # dynamic-population run at default telemetry.
+    validate(build_round_record(_base(), population=_population()))
+    # A churn-rejected round carries the quorum fields too; large drift
+    # cohorts report the size only (no id list).
+    big = {k: v for k, v in _population().items()
+           if k != "drift_clients"}
+    big["drift_cohort_size"] = 500
+    big["rejected_by_churn"] = True
+    validate(build_round_record(
+        {**_base(), "cohort_hash": 99, "survivor_count": 2,
+         "round_rejected": True, "mean_client_loss": 1.2},
+        population=big,
+    ))
+
+
 def test_lowest_version_stamping_preserved():
-    """Adding v8 must not disturb the lower stamps: the version is the
+    """Adding v9 must not disturb the lower stamps: the version is the
     LOWEST that describes the record (longitudinal byte-identity)."""
     assert "schema_version" not in build_round_record(_base())
     assert build_round_record(_base(), _telemetry())[
@@ -327,6 +364,8 @@ def test_lowest_version_stamping_preserved():
                               _costmodel())["schema_version"] == 6
     assert build_round_record(_base(), None, None, None, None, None,
                               _valuation())["schema_version"] == 7
+    assert build_round_record(_base(), sweep=_sweep())[
+        "schema_version"] == 8
 
 
 def test_version_content_mismatches_rejected():
@@ -458,6 +497,23 @@ def test_version_content_mismatches_rejected():
         bad = build_round_record(_base(), sweep={**_sweep(), **poison})
         with pytest.raises(jsonschema.ValidationError):
             validate(bad)
+    # v8 stamp smuggling a population sub-object (the builder always
+    # stamps population records v9).
+    bad = build_round_record(_base(), sweep=_sweep())
+    bad["population"] = _population()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v9 stamp without the population sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 9
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown population keys are schema breaks, not silent extensions.
+    bad = build_round_record(
+        _base(), population={**_population(), "mystery": 1}
+    )
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
 
 
 def test_missing_required_base_fields_rejected():

@@ -221,6 +221,12 @@ def test_config_hash_off_gate_invariance():
         # Off-gated at its 'exact' default like the valuation knobs
         # (ISSUE 10, ops/sampling.py).
         "participation_sampler",
+        # Off-gated at their inactive defaults (ISSUE 11, sweep/):
+        # persistence knobs sit in _NON_PROGRAM_FIELDS already.
+        "sweep_seeds", "sweep_points", "sweep_strategy",
+        # Off-gated at 'static' (ISSUE 13, robustness/population.py).
+        "population", "population_seed", "join_rate", "depart_rate",
+        "drift_fraction", "drift_factor",
     ):
         d.pop(k, None)
     pre_feature = hashlib.sha256(
