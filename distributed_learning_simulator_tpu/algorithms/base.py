@@ -100,6 +100,15 @@ class Algorithm:
     # buffer to hold late updates; the Shapley servers refuse in their
     # constructors — subset utilities assume a synchronous cohort).
     supports_async: bool = False
+    # Whether the algorithm's post_round subset evaluation partitions its
+    # vmapped model-batch axis over a single-host mesh (mesh_devices > 1;
+    # algorithms/shapley.eval_mesh_devices + _SubsetEvaluator). A
+    # CAPABILITY flag, not a gate: False just means post_round ignores
+    # the mesh (the round program's client-axis sharding is independent
+    # of it). The Shapley servers set True — their subset utilities are
+    # independent, so sharding the evaluation batch is pure throughput,
+    # bit-identical to the serial walk by construction.
+    shards_subset_eval: bool = False
 
     def __init__(self, config):
         self.config = config

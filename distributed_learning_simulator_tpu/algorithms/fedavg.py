@@ -1044,7 +1044,16 @@ class FedAvg(Algorithm):
         ``process_client_payload`` is fed's identity here (fed_quant is
         refused — its live fused path quantizes with per-chunk payload
         keys that a whole-stack replay cannot reproduce), so the
-        replayed stack is bit-for-bit the uploads the round aggregated.
+        replayed stack is bit-for-bit the uploads the round aggregated
+        on single-device runs. One documented softening under
+        single-host ``mesh_devices > 1`` (composes since PR 14): the
+        LIVE round trains the cohort client-axis-sharded while this
+        replay runs at full width on one placement, and per-device
+        batch tiling can move trained params by last-ulp amounts — far
+        below the audit walk's Monte-Carlo noise (the graded-
+        differential Spearman floor is pinned under mesh,
+        tests/test_gtg_mesh.py), but "bit-for-bit" is a serial-run
+        statement.
         """
         from distributed_learning_simulator_tpu.ops.augment import get_augment
 

@@ -41,10 +41,12 @@ from __future__ import annotations
 import math
 import os
 import pickle
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from distributed_learning_simulator_tpu.algorithms.base import RoundContext
 from distributed_learning_simulator_tpu.algorithms.fedavg import FedAvg
@@ -66,6 +68,32 @@ from distributed_learning_simulator_tpu.utils.logging import get_logger
 
 _EVAL_CHUNK = 16  # subset models evaluated per batched XLA call
 _PREFIX_BLOCK = 16  # GTG permutation prefixes fetched per fused call
+
+#: Mesh axis the subset evaluator partitions its MODEL-BATCH dimension
+#: over (mesh-sharded GTG, ROADMAP item 5). Distinct from the round
+#: program's "clients" axis: the round shards the client stack, the
+#: evaluator shards the vmapped subset/permutation-group axis with the
+#: stack REPLICATED — each device evaluates its own slice of the wave's
+#: subset models with no cross-device reduction anywhere.
+SUBSET_AXIS = "subsets"
+
+
+def eval_mesh_devices(config) -> int | None:
+    """How many devices the Shapley subset evaluators shard their batch
+    axis over: ``config.mesh_devices`` when a single process owns the
+    whole mesh, else None (the serial evaluator). Multihost stays
+    unsharded — the GTG walk is data-dependent HOST control flow, and a
+    multi-process walk would need every process to replay identical
+    truncation/convergence decisions against collectively-fetched
+    utilities; single-host mesh sharding is the supported capability."""
+    d = getattr(config, "mesh_devices", None) or 1
+    if d <= 1 or getattr(config, "multihost", False):
+        return None
+    if getattr(config, "execution_mode", "vmap").lower() == "threaded":
+        # The threaded oracle ignores mesh_devices everywhere else; its
+        # record writer also predates the v10 gtg sub-object routing.
+        return None
+    return int(d)
 
 
 class SubsetMemo(dict):
@@ -399,12 +427,47 @@ class _SubsetEvaluator:
     stack) chunk 16 re-reads ~30 TB over a 266k-subset round; chunk 64
     cuts it 4x. The ceiling is activation memory: chunk models x
     eval-batch activations live at once.
+
+    **Mesh sharding** (``mesh_devices > 1``, single host): the vmapped
+    model-batch axis of each fused call is partitioned over a
+    ``SUBSET_AXIS`` device mesh with the client stack, sizes, previous
+    global and eval batches REPLICATED — one call then evaluates
+    ``chunk x D`` subset models, ``chunk`` per device, in ~the serial
+    call's wall time. Per-device call shapes are IDENTICAL to the
+    serial evaluator's (the width scales with D exactly so each
+    device's local program is the serial program), which is what makes
+    sharded utilities — and therefore SVs, permutation counts, eval
+    counts, and the memo contents — bit-identical to the serial walk
+    (tests/test_gtg_mesh.py pins this at forced D=2). There are no
+    cross-device reductions anywhere: a subset's weighted mean contracts
+    over the REPLICATED client axis on whichever device owns that subset
+    row, in the serial reduction order.
     """
 
     def __init__(self, eval_fn, chunk: int = _EVAL_CHUNK,
-                 eval_dtype: str = "float32"):
+                 eval_dtype: str = "float32",
+                 mesh_devices: int | None = None):
         self._chunk = int(chunk)
         self._eval_dtype = jnp.dtype(eval_dtype)
+        self._mesh = None
+        self._devices = 1
+        if mesh_devices is not None and mesh_devices > 1:
+            from distributed_learning_simulator_tpu.parallel.mesh import (
+                make_mesh,
+            )
+
+            self._mesh = make_mesh(int(mesh_devices), axis_name=SUBSET_AXIS)
+            self._devices = int(mesh_devices)
+            self._rep = NamedSharding(self._mesh, PartitionSpec())
+            self._shd = NamedSharding(
+                self._mesh, PartitionSpec(SUBSET_AXIS)
+            )
+        # One-slot identity caches for the per-round replicated operands:
+        # the walk calls the evaluator hundreds of times per round with
+        # the SAME stack/sizes/prev/batches objects, and re-running the
+        # placement tree_map per call would pay leaves x calls of no-op
+        # device_puts.
+        self._role_cache: dict[str, tuple] = {}
 
         # eval_fn(params, xb, yb, mb) -> {'loss','accuracy'}
         def eval_one(client_params, sizes, mask, prev_global, xb, yb, mb):
@@ -453,6 +516,53 @@ class _SubsetEvaluator:
     def eval_dtype(self):
         return self._eval_dtype
 
+    @property
+    def devices(self) -> int:
+        """Devices the model-batch axis is partitioned over (1 = serial)."""
+        return self._devices
+
+    @property
+    def call_width(self) -> int:
+        """Nominal subset models per fused call: the configured chunk
+        times the mesh width (each device keeps the serial chunk's
+        activation envelope — and the serial call's exact shapes)."""
+        return self._chunk * self._devices
+
+    def _place_rep(self, role, tree):
+        """Replicate a per-round operand over the subset mesh ONCE
+        (identity-cached per role; serial mode passes through untouched).
+        """
+        if self._mesh is None:
+            return tree
+        cached = self._role_cache.get(role)
+        if cached is not None and cached[0] is tree:
+            return cached[1]
+        placed = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._rep), tree
+        )
+        self._role_cache[role] = (tree, placed)
+        return placed
+
+    def _shard_rows(self, tree):
+        """Partition a per-call tree's LEADING (model-batch) axis over
+        the subset mesh; the serial path keeps today's jnp.asarray."""
+        if self._mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, tree)
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._shd), tree
+        )
+
+    def release_round(self):
+        """Drop the per-round placement cache at the END of a walk. In
+        mesh mode the cache holds BOTH the caller's stack and its D-way
+        replicated copy; without this release those buffers would stay
+        pinned through the NEXT round's training — an extra full-stack
+        HBM footprint the serial evaluator never held. Every walk driver
+        (GTG/multiround post_round, the valuation auditor) calls it when
+        its round's evaluations are done; a serial evaluator's cache is
+        never populated, so this is a no-op there."""
+        self._role_cache.clear()
+
     def _reraise_oom(self, e, n_models: int, eval_batches,
                      min_chunk: int = 1):
         """Shared actionable-hint treatment for device OOMs in both the
@@ -493,23 +603,34 @@ class _SubsetEvaluator:
         tensordot still accumulates f32 (ops/aggregate.subset_weighted_mean)
         and the subset model handed to eval is f32-ranged."""
         if self._eval_dtype == jnp.float32:
-            return client_params
+            # Under a mesh, also re-place the (possibly client-axis-
+            # sharded) stack REPLICATED over the subset mesh once per
+            # round — one all-gather, amortized over every fused call.
+            return self._place_rep("stack", client_params)
         cast = jax.tree_util.tree_map(
             lambda a: a.astype(self._eval_dtype), client_params
         )
         # Materialize now: the cast must happen once, not get re-fused into
         # every downstream evaluator call by lazy dispatch.
-        return jax.block_until_ready(cast)
+        return self._place_rep("stack", jax.block_until_ready(cast))
 
     def __call__(self, client_params, sizes, masks, prev_global, eval_batches):
         """masks: [M, n] numpy 0/1. Returns [M] numpy accuracies.
 
         All chunks are dispatched first and fetched with ONE device_get:
         per-chunk fetches each pay a full device->host round-trip (~100 ms
-        through a tunnel), which dominated GTG rounds at large N.
+        through a tunnel), which dominated GTG rounds at large N. Under a
+        subset mesh each call carries ``chunk x D`` mask rows sharded over
+        the devices (``chunk`` per device — the serial call's shapes), so
+        the loop makes D-fold fewer dispatches over the same mask list in
+        the same order; padded garbage rows are discarded host-side as
+        before.
         """
-        xb, yb, mb = eval_batches
-        size = self._chunk
+        client_params = self._place_rep("stack", client_params)
+        sizes = self._place_rep("sizes", sizes)
+        prev_global = self._place_rep("prev_global", prev_global)
+        xb, yb, mb = self._place_rep("batches", tuple(eval_batches))
+        size = self.call_width
         pending = []
         try:
             for start in range(0, len(masks), size):
@@ -520,15 +641,17 @@ class _SubsetEvaluator:
                         [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
                     )
                 vals = self._eval_chunk(
-                    client_params, sizes, jnp.asarray(chunk), prev_global,
-                    xb, yb, mb,
+                    client_params, sizes, self._shard_rows(chunk),
+                    prev_global, xb, yb, mb,
                 )
                 pending.append(vals[: size - pad] if pad else vals)
             return np.concatenate(jax.device_get(pending))
         except jax.errors.JaxRuntimeError as e:
             if not is_device_oom(e):
                 raise
-            self._reraise_oom(e, size, eval_batches)
+            # Per-DEVICE width: the resident-activation envelope the hint
+            # sizes against is each device's slice, not the call total.
+            self._reraise_oom(e, self._chunk, eval_batches)
 
 
 class _CumsumPrefixWalker:
@@ -560,17 +683,27 @@ class _CumsumPrefixWalker:
     def __init__(self, evaluator, client_params, sizes, prev_global,
                  eval_batches, n: int):
         self._ev = evaluator
-        self._stack = client_params
-        self._sizes = sizes
-        self._prev_global = prev_global
-        self._eval_batches = eval_batches
+        # Per-round operands replicated over the subset mesh once (no-op
+        # pass-through for the serial evaluator).
+        self._stack = evaluator._place_rep("stack", client_params)
+        self._sizes = evaluator._place_rep("sizes", sizes)
+        self._prev_global = evaluator._place_rep("prev_global", prev_global)
+        self._eval_batches = evaluator._place_rep(
+            "batches", tuple(eval_batches)
+        )
         self._n = n
         self._block = min(_PREFIX_BLOCK, n)
         # Group size: the fused call evaluates group x block prefix models,
         # so group*block matches the masked path's shapley_eval_chunk
         # activation envelope (floor one group — cumsum mode's minimum call
-        # width is one block of models).
-        self._group = max(1, evaluator._chunk // self._block)
+        # width is one block of models). Under a subset mesh the group
+        # scales by the device count: each device then advances the
+        # SERIAL group's worth of permutations — per-device call shapes
+        # identical to the serial walker's, which is the bit-identity
+        # mechanism (class docstring of _SubsetEvaluator).
+        self._group = (
+            max(1, evaluator._chunk // self._block) * evaluator.devices
+        )
         self._carry = None
         self._carry_t = None
         self._row_of: dict[int, int] = {}
@@ -628,13 +761,18 @@ class _CumsumPrefixWalker:
                 block = np.zeros((g_size, b_size), np.int32)
                 for g, p in enumerate(group):
                     block[g, : j1 - j0] = perms[p][j0:j1]
-                c_g = jax.tree_util.tree_map(
+                # Per-call carries/indices partition over the subset mesh
+                # (group-axis rows; serial mode = today's jnp.asarray /
+                # pass-through): a short final group was already padded
+                # by _wave_carries, so the group axis always splits
+                # evenly over the devices.
+                c_g = self._ev._shard_rows(jax.tree_util.tree_map(
                     lambda c: c[start : start + g_size], carry
-                )
+                ))
                 accs, nc, nct = self._ev._prefix_wave(
                     self._stack, self._sizes, c_g,
-                    carry_t[start : start + g_size],
-                    jnp.asarray(block), self._prev_global,
+                    self._ev._shard_rows(carry_t[start : start + g_size]),
+                    self._ev._shard_rows(block), self._prev_global,
                     *self._eval_batches,
                 )
                 pending.append((group, accs))
@@ -644,7 +782,10 @@ class _CumsumPrefixWalker:
             if not is_device_oom(e):
                 raise
             self._ev._reraise_oom(
-                e, g_size * b_size, self._eval_batches, min_chunk=b_size,
+                # Per-DEVICE width: each device holds its group slice's
+                # models; g_size is a multiple of the device count.
+                e, (g_size // self._ev.devices) * b_size,
+                self._eval_batches, min_chunk=b_size,
             )
         if len(new_carries) == 1:
             self._carry, self._carry_t = new_carries[0]
@@ -733,6 +874,12 @@ class MultiRoundShapley(FedAvg):
     # overrides the FedAvg-family opt-in; the simulator refuses with
     # the cause.
     supports_streamed_residency = False
+    # Mesh capability (ROADMAP item 5): post_round's subset evaluation
+    # partitions its vmapped mask-batch axis over a single-host mesh
+    # (mesh_devices > 1) with the client stack replicated — subset
+    # utilities are independent, so sharding is pure throughput.
+    # Multihost keeps the serial evaluator (eval_mesh_devices).
+    shards_subset_eval = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -765,6 +912,7 @@ class MultiRoundShapley(FedAvg):
             eval_fn,
             chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
             eval_dtype=_resolve_eval_dtype(self.config, default="float32"),
+            mesh_devices=eval_mesh_devices(self.config),
         )
 
     def post_round(self, ctx: RoundContext) -> dict:
@@ -805,6 +953,7 @@ class MultiRoundShapley(FedAvg):
                 getattr(self.config, "shapley_eval_samples", None),
             ),
         )
+        self._evaluator.release_round()
         utilities = {
             frozenset(np.flatnonzero(m).tolist()): float(u)
             for m, u in zip(masks, utilities_arr)
@@ -845,6 +994,13 @@ class GTGShapley(FedAvg):
     # Same as MultiRoundShapley: the permutation walk's subset utilities
     # assume a resident per-client stack; streamed residency is refused.
     supports_streamed_residency = False
+    # Mesh capability (ROADMAP item 5): permutation walks are
+    # independent given the memo, so the walk's prefix waves shard
+    # their group axis over a single-host mesh — bit-identical to the
+    # serial walk (per-device call shapes are the serial call's; see
+    # _SubsetEvaluator). Sharded rounds record the schema-v10 ``gtg``
+    # sub-object (devices, evals_per_s, wave width, walk seconds).
+    shards_subset_eval = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -924,6 +1080,7 @@ class GTGShapley(FedAvg):
             eval_fn,
             chunk=getattr(self.config, "shapley_eval_chunk", _EVAL_CHUNK),
             eval_dtype=_resolve_eval_dtype(self.config, default="bfloat16"),
+            mesh_devices=eval_mesh_devices(self.config),
         )
 
     def _converged(self, records: list[np.ndarray], n: int) -> bool:
@@ -948,6 +1105,7 @@ class GTGShapley(FedAvg):
             logger.info("round %d: truncated, shapley values all 0", round_idx)
             return {"shapley_values": sv, "gtg_permutations": 0}
 
+        t_walk = time.perf_counter()
         client_params = self._evaluator.prepare_stack(ctx.aux["client_params"])
         # Cross-round memo (config.gtg_cross_round_memo, ROADMAP item 4b):
         # seed this round's subset-utility memo from the last round with
@@ -1031,9 +1189,29 @@ class GTGShapley(FedAvg):
             prefix_mode=getattr(self.config, "gtg_prefix_mode", "cumsum"),
             memo=memo,
         )
+        walk_seconds = time.perf_counter() - t_walk
+        self._evaluator.release_round()
         sv = {i: float(v) for i, v in enumerate(sv_arr)}
         self.shapley_values[round_idx] = sv
         memo_extra = {}
+        if self._evaluator.devices > 1:
+            # Mesh-sharded walk provenance: the schema-v10 ``gtg``
+            # sub-object (the simulator routes it through the shared
+            # record builder). Attached ONLY when the walk actually
+            # sharded, so serial GTG runs keep their pre-feature records
+            # byte-identical — the established off-gate discipline.
+            memo_extra["gtg"] = {
+                "devices": self._evaluator.devices,
+                "evals_per_s": (
+                    round(memo.evaluated / walk_seconds, 1)
+                    if walk_seconds > 0 and memo.evaluated else None
+                ),
+                # Walk parallelism: subset models per fused evaluator
+                # call, partitioned over the devices (the serial chunk's
+                # envelope per device).
+                "wave_width": self._evaluator.call_width,
+                "walk_seconds": round(walk_seconds, 3),
+            }
         if cross_round:
             self._memo_store[cohort_key] = dict(memo)
             self.gtg_memo_hit_rate = memo.hit_rate()

@@ -496,8 +496,16 @@ class ExperimentConfig:
     # measured fidelity bound on the cheap estimator. Audits are pure
     # reads (training is untouched) and cost roughly one extra cohort
     # training pass + the walk; they refuse failure models, async mode,
-    # non-mean aggregation, persistent client optimizers, mesh/multihost,
+    # non-mean aggregation, persistent client optimizers, multihost,
     # and rounds_per_dispatch > 1 (the replay's exactness contract).
+    # Single-host mesh_devices > 1 COMPOSES: the audit walk's subset
+    # evaluation shards over the mesh, bit-identical to the serial walk
+    # (algorithms/shapley.eval_mesh_devices). Caveat, documented not
+    # hidden: under mesh the LIVE round's client training is sharded
+    # while the replay runs single-placement, so replayed uploads can
+    # differ by last-ulp tiling effects — far below the walk's
+    # Monte-Carlo noise; the operative contract there is the measured
+    # Spearman floor (pinned under mesh), not byte equality.
     valuation_audit_every: int = 0
     # Permutation budget per audit walk (also the number of permutations
     # drawn per truncated sampling iteration). Small-N audits converge
@@ -1078,12 +1086,18 @@ class ExperimentConfig:
                     "(the audit replays one round's key chain against "
                     "that round's pre-round global params)"
                 )
-            if self.multihost or (
-                self.mesh_devices is not None and self.mesh_devices > 1
-            ):
+            if self.multihost:
+                # Single-host mesh sharding COMPOSES (the audit walk's
+                # subset evaluation partitions over the mesh,
+                # algorithms/shapley.eval_mesh_devices — bit-identical
+                # to the serial walk); multihost does not: the audit's
+                # cohort replay and data-dependent walk are driven by
+                # ONE host process.
                 raise ValueError(
-                    "valuation audits do not compose with mesh/multihost "
-                    "sharding; run audits on a single device"
+                    "valuation audits do not compose with multihost: the "
+                    "audit's cohort replay and GTG walk are driven by a "
+                    "single host process; run audits on one host's mesh "
+                    "(single-process mesh_devices sharding is supported)"
                 )
         if self.profile_from_round < 0:
             raise ValueError(

@@ -1303,6 +1303,11 @@ def run_simulation(
         with annotate("post_round"), phase_timer.phase(
                 phase_round, "post_round"):
             extra = algorithm.post_round(ctx) or {}
+        # Mesh-sharded GTG walk provenance (algorithms/shapley.py): a
+        # ``gtg`` dict in the post_round extras is the schema-v10
+        # sub-object — routed through the shared record builder below
+        # (lowest-version stamping), never inlined into the v1 base.
+        gtg_rec = extra.pop("gtg", None)
         now = time.perf_counter()
         # Wall time between successive round completions: covers train +
         # eval + metric fetch + host post_round (Shapley time included —
@@ -1453,11 +1458,11 @@ def run_simulation(
             tel_rec is not None or cs_rec is not None
             or async_rec is not None or stream_rec is not None
             or cm_rec is not None or val_rec is not None
-            or pop_rec is not None
+            or pop_rec is not None or gtg_rec is not None
         ):
             record = build_round_record(
                 record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec,
-                val_rec, population=pop_rec,
+                val_rec, population=pop_rec, gtg=gtg_rec,
             )
         history.append(record)
         if metrics_path:

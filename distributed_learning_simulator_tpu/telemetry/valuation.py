@@ -403,6 +403,7 @@ class ValuationAuditor:
                 _EVAL_CHUNK,
                 _SubsetEvaluator,
                 cap_eval_batches,
+                eval_mesh_devices,
             )
 
             # f32 stack reads: the audit is the fidelity REFERENCE, so it
@@ -416,6 +417,11 @@ class ValuationAuditor:
                     self._config, "shapley_eval_chunk", _EVAL_CHUNK
                 ),
                 eval_dtype="float32" if dtype == "auto" else dtype,
+                # Budgeted audits at production cadence ride the SAME
+                # mesh as the run (single-host mesh_devices sharding of
+                # the walk's subset/group axis — bit-identical to the
+                # serial walk; multihost keeps the serial evaluator).
+                mesh_devices=eval_mesh_devices(self._config),
             )
             self._capped_batches = cap_eval_batches(
                 self._eval_batches,
@@ -502,6 +508,10 @@ class ValuationAuditor:
             memo=memo,
             starts_per_iteration=min(self._cv.audit_permutations, n),
         )
+        # Release the evaluator's per-round placement cache: in mesh mode
+        # it pins this audit's replicated stack copy until the next audit
+        # otherwise (algorithms/shapley._SubsetEvaluator.release_round).
+        evaluator.release_round()
         if cross_round:
             # Latest-walk-only retention: consecutive audits of the same
             # cohort reuse it; a changed cohort simply misses.
@@ -531,6 +541,17 @@ class ValuationAuditor:
             "converged": bool(converged),
             "memo_hit_rate": (
                 None if hit_rate is None else round(hit_rate, 4)
+            ),
+            # Walk sharding (algorithms/shapley.eval_mesh_devices): how
+            # many devices this audit's subset evaluation partitioned
+            # over — present ONLY when the walk actually sharded, so
+            # serial-audit configs keep their pre-PR-14 audit records
+            # byte-identical (the same no-opt-in-no-layout-change rule
+            # as the v10 gtg sub-object). Rendered with the wall-clock
+            # by report_run's valuation section.
+            **(
+                {"devices": int(evaluator.devices)}
+                if evaluator.devices > 1 else {}
             ),
             "seconds": round(time.perf_counter() - t0, 3),
         }

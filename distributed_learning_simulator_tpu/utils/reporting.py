@@ -38,7 +38,11 @@ import json
 # dynamic-population registration stream's per-round outcome: alive/
 # registered counts, joins, departures — total and in-cohort — the
 # planted drift cohort, and the rejected-by-churn flag;
-# robustness/population.py). A record
+# robustness/population.py). v10 adds the ``gtg`` sub-object (the
+# mesh-sharded GTG walk's provenance: devices the subset-evaluation
+# batch axis partitioned over, subset-eval throughput, the fused-call
+# wave width, and the walk's wall seconds; algorithms/shapley.py —
+# attached only on rounds whose walk actually sharded). A record
 # is stamped with the LOWEST version that describes it:
 # telemetry_level='off' keeps emitting v1 byte-for-byte,
 # client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
@@ -46,10 +50,12 @@ import json
 # 'resident' keeps records at v4 or below, cost_model_trace=None
 # keeps records at v5 or below, client_valuation='off' keeps
 # records at v6 or below, solo (non-sweep) runs keep records at v7
-# or below, and population='static' keeps records at v8 or below —
+# or below, population='static' keeps records at v8 or below, and
+# serial (single-device) GTG walks keep records at v9 or below —
 # longitudinal tooling never sees a
 # layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 9
+METRICS_SCHEMA_VERSION = 10
+_POPULATION_SCHEMA_VERSION = 9
 _SWEEP_SCHEMA_VERSION = 8
 _VALUATION_SCHEMA_VERSION = 7
 _COSTMODEL_SCHEMA_VERSION = 6
@@ -110,7 +116,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
                        costmodel: dict | None = None,
                        valuation: dict | None = None,
                        sweep: dict | None = None,
-                       population: dict | None = None) -> dict:
+                       population: dict | None = None,
+                       gtg: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -132,17 +139,21 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     ``"valuation"`` key; a sweep dict (sweep/engine.py per-point
     provenance) upgrades it to v8 under the ``"sweep"`` key; a
     population dict (robustness/population.PopulationModel.round_record)
-    upgrades it to v9 under the ``"population"`` key.
+    upgrades it to v9 under the ``"population"`` key; a gtg dict (the
+    mesh-sharded GTG walk's provenance, algorithms/shapley.GTGShapley
+    .post_round) upgrades it to v10 under the ``"gtg"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
     ) and stream is None and costmodel is None and valuation is None and (
         sweep is None
-    ) and population is None:
+    ) and population is None and gtg is None:
         return base
     record = dict(base)
-    if population is not None:
+    if gtg is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif population is not None:
+        record["schema_version"] = _POPULATION_SCHEMA_VERSION
     elif sweep is not None:
         record["schema_version"] = _SWEEP_SCHEMA_VERSION
     elif valuation is not None:
@@ -173,6 +184,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["sweep"] = sweep
     if population is not None:
         record["population"] = population
+    if gtg is not None:
+        record["gtg"] = gtg
     return record
 
 
@@ -249,5 +262,18 @@ def gtg_round_record(history, **extra):
         "permutations": h.get("gtg_permutations"),
         "subset_evals": h.get("gtg_subset_evals"),
     }
+    # Subset-eval throughput of the reported round, against the WHOLE
+    # round's wall for every mode — a conservative denominator (it
+    # includes training + the round eval), but the SAME one whether the
+    # walk sharded or not, so a sharded-vs-serial pair (bench's gtg leg
+    # flipping BENCH_GTG_DEVICES, measure_gtg_scale's serial reference)
+    # compares real end-to-end throughput, never a denominator switch.
+    # The walk-window-only rate lives in the v10 ``gtg`` sub-object
+    # (``evals_per_s`` there divides by ``walk_seconds``).
+    denom = h.get("round_seconds")
+    evals = record["subset_evals"]
+    record["evals_per_s"] = (
+        round(evals / denom, 1) if evals and denom else None
+    )
     record.update(extra)
     return record

@@ -62,6 +62,14 @@ same program static, robustness/population.py) gets
 ``--churn-overhead-threshold`` as an absolute ceiling, default 0.10 —
 the registration stream must ride the round at marginal cost, never
 relatively tracked. The
+``gtg`` leg's ``gtg_scaling_ratio`` (D=2/D=1 subset-eval throughput of
+the mesh-sharded GTG walk's scaling microbench, algorithms/shapley.py)
+gets ``--gtg-scaling-threshold`` as an absolute floor, default 1.5 —
+two devices must buy at least half a device's worth of extra walk
+throughput, never relatively tracked; bench arms the key only when the
+host could honestly measure it (>= 2 usable cores — a 1-core cgroup
+cannot overlap two devices' compute, and the unarmed measurement stays
+in the record under ``gtg.scaling``). The
 ``costmodel`` leg's ``model_error_ratio`` per program (predicted /
 measured per-round ms from the roofline model, telemetry/costmodel.py)
 is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
@@ -344,6 +352,33 @@ def sweep_amortization_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def gtg_scaling_gate(record: dict, threshold: float) -> dict | None:
+    """In-record GTG mesh-scaling gate: bench.py's ``gtg`` leg runs a
+    D=2-vs-D=1 subset-eval throughput microbench through the real
+    ``_SubsetEvaluator`` (the mesh-sharded GTG walk's fused-call shape,
+    algorithms/shapley.py) and records ``gtg_scaling_ratio`` — ONLY when
+    the host had >= 2 usable cores, so the number is an honest
+    device-parallel measurement. A ratio below ``threshold`` means
+    sharding the walk stopped paying (lost replication short-circuit,
+    accidental collective, per-call placement cost) — a regression
+    regardless of the old record. Judged ABSOLUTELY (the PR 4/5/8 gate
+    precedent: the ratio sits near a fixed operating point where a
+    relative gate would flap). None when the leg is absent (including
+    the unarmed 1-core case) or the floor holds."""
+    ratio = get_path(record, "gtg.gtg_scaling_ratio")
+    if ratio is None or ratio >= threshold:
+        return None
+    return {
+        "metric": "gtg.gtg_scaling_ratio",
+        "description": (
+            "D=2/D=1 subset-eval throughput of the mesh-sharded GTG "
+            "walk (two devices must keep buying walk throughput)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def churn_overhead_gate(record: dict, threshold: float) -> dict | None:
     """In-record open-world-churn gate: bench.py's ``churn`` leg runs a
     10x population-growth ``population='dynamic'`` run against the same
@@ -466,6 +501,13 @@ def main(argv: list[str] | None = None) -> int:
                          "must keep tracking exact Shapley on the "
                          "differential config; measured operating point "
                          "~0.85-0.9)")
+    ap.add_argument("--gtg-scaling-threshold", type=float, default=1.5,
+                    help="min tolerated D=2/D=1 subset-eval throughput "
+                         "ratio in the NEW record's gtg leg (default 1.5 "
+                         "— sharding the GTG walk over two devices must "
+                         "buy at least 1.5x; bench records the key only "
+                         "on hosts that can honestly measure it, i.e. "
+                         ">= 2 usable cores)")
     ap.add_argument("--churn-overhead-threshold", type=float, default=0.10,
                     help="max tolerated dynamic-vs-static round-time "
                          "overhead ratio in the NEW record's churn leg "
@@ -507,6 +549,7 @@ def main(argv: list[str] | None = None) -> int:
         stream_cohort_rate_gate(new, args.stream_cohort_rate_threshold),
         sweep_amortization_gate(new, args.sweep_amortization_threshold),
         valuation_corr_gate(new, args.valuation_corr_threshold),
+        gtg_scaling_gate(new, args.gtg_scaling_threshold),
         churn_overhead_gate(new, args.churn_overhead_threshold),
     ):
         if gate is not None:

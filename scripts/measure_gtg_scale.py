@@ -5,7 +5,7 @@ permutations per round, subset evaluations, and peak HBM. Run on the real
 chip:
 
     python scripts/measure_gtg_scale.py [rounds] [eval_samples] [eval_chunk] \
-        [max_permutations] [eval_dtype] [prefix_mode]
+        [max_permutations] [eval_dtype] [prefix_mode] [mesh_devices]
 
 (eval_chunk default 64 — the chunk-16-vs-64 comparison in
 docs/PERFORMANCE.md § Scale validation is reproduced by passing 16/64.
@@ -15,7 +15,15 @@ bfloat16 = the resolved GTG default; pass float32 for the r4
 configuration. prefix_mode default cumsum = config default; pass masked
 for the pre-round-6 per-prefix aggregation path — the cumsum-vs-masked
 before/after in docs/PERFORMANCE.md § GTG at scale is this script run
-twice.)
+twice. mesh_devices default 1 = the serial walk; > 1 shards the GTG
+walk's subset/group axis over that many devices — bit-identical SVs,
+permutation counts and eval counts (algorithms/shapley.py) — and the
+JSON then records BOTH sides: the sharded ``gtg_round_seconds`` plus a
+serial reference run (``gtg_round_seconds_serial``/``shard_speedup``;
+GTG_SCALE_SERIAL=0 skips the reference). CPU runs use the established
+idiom from tests/test_multichip.py —
+``XLA_FLAGS=--xla_force_host_platform_device_count=D`` — which this
+script applies itself when JAX_PLATFORMS=cpu and the flag is absent.)
 
 The last line is ONE JSON record tracking the converged-GTG round cost —
 the wall-clock of the final non-round-truncated round (round 0 carries the
@@ -48,6 +56,20 @@ def main():
     max_perms = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     eval_dtype = sys.argv[5] if len(sys.argv) > 5 else "bfloat16"
     prefix_mode = sys.argv[6] if len(sys.argv) > 6 else "cumsum"
+    mesh_devices = int(sys.argv[7]) if len(sys.argv) > 7 else 1
+
+    if (
+        mesh_devices > 1
+        and os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+        and "--xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    ):
+        # The tests/test_multichip.py CPU idiom, applied before the first
+        # jax import below: virtual host devices stand in for the mesh.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={mesh_devices}"
+        )
 
     from distributed_learning_simulator_tpu.config import ExperimentConfig
     from distributed_learning_simulator_tpu.simulator import run_simulation
@@ -60,6 +82,7 @@ def main():
         shapley_eval_samples=eval_samples, shapley_eval_chunk=eval_chunk,
         gtg_max_permutations=max_perms or None,
         shapley_eval_dtype=eval_dtype, gtg_prefix_mode=prefix_mode,
+        mesh_devices=mesh_devices if mesh_devices > 1 else None,
         # Streaming valuation rides the same run (ISSUE 9): its per-round
         # cost is measured against these GTG rounds below, and its final
         # vector correlates against the run's own exact per-round SVs —
@@ -150,10 +173,27 @@ def main():
         if steady:
             est_round_s = sorted(steady)[len(steady) // 2]
 
+    # Sharded-vs-serial reference (mesh_devices > 1): the same workload's
+    # serial walk, so the JSON carries BOTH sides of the scaling claim in
+    # one artifact (sharded == serial is bit-identical by contract, so
+    # only the wall-clock differs). GTG_SCALE_SERIAL=0 skips.
+    serial_round_s = None
+    if mesh_devices > 1 and os.environ.get("GTG_SCALE_SERIAL", "1") != "0":
+        serial_result = run_simulation(
+            dataclasses.replace(
+                config, mesh_devices=None, log_level="WARNING",
+            ),
+            setup_logging=False,
+        )
+        serial_rec = gtg_round_record(serial_result["history"])
+        if serial_rec is not None:
+            serial_round_s = serial_rec["value"]
+
     rec = gtg_round_record(
         result["history"],
         clients=n, prefix_mode=prefix_mode, eval_samples=eval_samples,
         eval_chunk=eval_chunk, eval_dtype=eval_dtype,
+        mesh_devices=mesh_devices,
         peak_hbm_gib=round(peak / 2**30, 2) if peak else None,
         # Cross-round memo reuse at scale (ROADMAP item 4b).
         gtg_memo_hit_rate=result["gtg_memo_hit_rate"],
@@ -171,6 +211,10 @@ def main():
     )
     if rec is not None and est_round_s:
         rec["estimator_gap_ratio"] = round(rec["value"] / est_round_s, 1)
+    if rec is not None and serial_round_s is not None:
+        rec["gtg_round_seconds_serial"] = serial_round_s
+        if rec["value"]:
+            rec["shard_speedup"] = round(serial_round_s / rec["value"], 2)
     if rec is not None:
         print(json.dumps(rec))
 

@@ -43,7 +43,10 @@ sweep/engine.py), and v9 (``population`` sub-object — rendered as the
 dynamic-population section: alive-N-over-time sparkline, per-round
 join/depart counts, churn-rejected rounds, and the planted
 drift-cohort overlay against the v7 valuation top/bottom tables;
-robustness/population.py). The only
+robustness/population.py), and v10 (``gtg`` sub-object — the
+mesh-sharded GTG walk's per-round provenance; its audit-side face,
+wall seconds + device count, rides the v7 valuation audit line;
+algorithms/shapley.py). The only
 heavy import (jax, via utils.tracing) is deferred behind ``--trace``,
 so metrics-only reporting is instant.
 """
@@ -754,13 +757,23 @@ def render_summary(summary: dict) -> list[str]:
             )
             sp = a.get("spearman")
             pe = a.get("pearson")
+            # Audit cost face (mesh-sharded GTG): wall seconds + how many
+            # devices the walk's subset evaluation partitioned over
+            # (absent on pre-v10-era records — rendered only when known).
+            secs = a.get("seconds")
+            devs = a.get("devices")
+            cost = ""
+            if secs is not None:
+                cost = f", {secs:.1f}s" + (
+                    f" on {devs} device(s)" if devs is not None else ""
+                )
             lines.append(
                 "  GTG audit (round {}): spearman {} pearson {} over {} "
-                "permutation(s), converged={}{}".format(
+                "permutation(s), converged={}{}{}".format(
                     a["round"],
                     "n/a" if sp is None else f"{sp:.3f}",
                     "n/a" if pe is None else f"{pe:.3f}",
-                    a["permutations"], a["converged"], hit,
+                    a["permutations"], a["converged"], hit, cost,
                 )
             )
 

@@ -436,6 +436,57 @@ def test_churn_overhead_self_gate(cb, tmp_path):
     assert proc.returncode == 0
 
 
+def test_gtg_scaling_not_relatively_tracked(cb):
+    """The D=2/D=1 subset-eval throughput ratio sits near a fixed
+    operating point (~2.0 on a real mesh) — like every other in-record
+    ratio it must never be a relative TRACKED metric; only the absolute
+    floor judges it."""
+    old, new = _record(), _record()
+    old["gtg"]["gtg_scaling_ratio"] = 1.9
+    new["gtg"]["gtg_scaling_ratio"] = 1.6
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "gtg_scaling" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_gtg_scaling_self_gate(cb, tmp_path):
+    """In-record absolute floor: a mesh-sharded walk that stops buying
+    throughput (D=2/D=1 below the floor) gates on the NEW record
+    alone; an unarmed record (1-core host — bench keeps the measured
+    ratio under gtg.scaling but never sets the gated key) skips."""
+    assert cb.gtg_scaling_gate(_record(), 1.5) is None  # key absent
+    # Unarmed 1-core measurement: ratio recorded, gate key absent.
+    unarmed = _record()
+    unarmed["gtg"]["scaling"] = {"d2_over_d1": 1.05, "host_cores": 1}
+    assert cb.gtg_scaling_gate(unarmed, 1.5) is None
+    ok = _record()
+    ok["gtg"]["gtg_scaling_ratio"] = 1.82
+    assert cb.gtg_scaling_gate(ok, 1.5) is None
+    bad = _record()
+    bad["gtg"]["gtg_scaling_ratio"] = 1.12
+    entry = cb.gtg_scaling_gate(bad, 1.5)
+    assert entry and entry["new"] == 1.12 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "gtg.gtg_scaling_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--gtg-scaling-threshold", "1.0"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+
+
 def test_model_drift_not_relatively_tracked(cb):
     """model_error_ratio sits near 1.0 — like the other in-record
     ratios it must never be a relative TRACKED metric (PR 4/5

@@ -1,0 +1,272 @@
+"""Mesh-sharded GTG: permutation-parallel Shapley evaluation (ISSUE 14).
+
+The multi-walk determinism contract, generalized from the PR 1 prefix-mode
+differential: the mesh-sharded walk (the subset evaluator's vmapped
+model-batch axis partitioned over D devices, client stack replicated —
+algorithms/shapley.py) must be BIT-identical to the serial walk on a fixed
+seed — SVs, permutation counts, eval counts, convergence flags, and the
+cross-permutation ``SubsetMemo``'s exact contents (keys AND values),
+including eps-truncated walks. The mechanism: each device's local call
+shapes are exactly the serial evaluator's (the call width scales by D),
+so XLA compiles the identical per-element program — nothing reduces
+across devices. Plus: the schema-v10 ``gtg`` record sub-object on sharded
+end-to-end runs (serial runs keep pre-feature records), the
+audit-under-mesh fidelity pin, and the lifted-vs-kept refusal causes.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import jsonschema
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.algorithms.shapley import (
+    GTGShapley,
+    SubsetMemo,
+    _SubsetEvaluator,
+    eval_mesh_devices,
+    eval_subsets,
+    gtg_walk,
+)
+from distributed_learning_simulator_tpu.config import ExperimentConfig
+from distributed_learning_simulator_tpu.simulator import run_simulation
+
+_SCHEMA_PATH = os.path.join(
+    os.path.dirname(__file__), "data", "metrics_record.schema.json"
+)
+
+
+def _validate_record(record: dict) -> None:
+    with open(_SCHEMA_PATH) as f:
+        jsonschema.validate(record, json.load(f))
+
+
+# ---- walk-level contract: sharded == serial, bit for bit -------------------
+#
+# Driven through gtg_walk/_SubsetEvaluator directly on a FIXED synthetic
+# stack: end-to-end runs shard the TRAINING client axis too, whose
+# per-device tiling legitimately moves the trained stack by ulps (the
+# documented resident-vs-mesh reduction-order tolerance), so the walk
+# contract is pinned where it is exact — same inputs, D in {1, 2}.
+
+
+def _toy_workload(n=20, p=400, seed=3):
+    rng = np.random.default_rng(seed)
+    stack = {"w": jnp.asarray(rng.standard_normal((n, p)), jnp.float32)}
+    sizes = jnp.asarray(rng.integers(1, 9, n), jnp.float32)
+    prev = {"w": jnp.asarray(rng.standard_normal(p), jnp.float32)}
+    xb = jnp.asarray(rng.standard_normal((2, 32, p)), jnp.float32)
+    yb = jnp.asarray(rng.integers(0, 4, (2, 32)), jnp.int32)
+    mb = jnp.ones((2, 32), jnp.float32)
+    return stack, sizes, prev, (xb, yb, mb)
+
+
+def _toy_eval(params, xb, yb, mb):
+    h = jnp.tanh(xb @ params["w"])
+    return {"accuracy": jnp.sum(h * mb) / jnp.sum(mb), "loss": 0.0}
+
+
+def _walk(devices, prefix_mode, eps, chunk=16, n=20, cap=12):
+    stack, sizes, prev, batches = _toy_workload(n=n)
+    ev = _SubsetEvaluator(
+        _toy_eval, chunk=chunk,
+        mesh_devices=devices if devices and devices > 1 else None,
+    )
+    memo = SubsetMemo()
+    eval_subsets(ev, stack, sizes, prev, batches, n, memo,
+                 [frozenset(), frozenset(range(n))])
+    rng = np.random.default_rng(7)
+    sv, n_perms, converged = gtg_walk(
+        ev, stack, sizes, prev, batches, n, rng,
+        eps=eps, cap=cap, last_k=10, converge_criteria=0.05,
+        trunc_ref=memo[frozenset(range(n))], prefix_mode=prefix_mode,
+        memo=memo,
+    )
+    return sv, n_perms, converged, dict(memo), memo.evaluated
+
+
+@pytest.mark.parametrize("prefix_mode", ["cumsum", "masked"])
+@pytest.mark.parametrize("eps", [1e-9, 0.02])
+def test_sharded_walk_bit_identical(prefix_mode, eps):
+    """D=2 == D=1 bit for bit: SVs, permutation counts, convergence,
+    eval counts, and the memo's exact keys AND values — full walks
+    (eps=1e-9: truncation never fires) and eps-truncated walks (0.02:
+    walks stop mid-permutation; the sharded wave must drop exactly the
+    same carries). n=20 forces multi-block walks (block 16 + short
+    final block 4), so wave padding, the short-block guard, and the
+    group compaction are all on the sharded path."""
+    serial = _walk(1, prefix_mode, eps)
+    sharded = _walk(2, prefix_mode, eps)
+    np.testing.assert_array_equal(serial[0], sharded[0])
+    assert serial[1] == sharded[1]  # permutation counts
+    assert serial[2] == sharded[2]  # convergence flag
+    assert serial[3] == sharded[3]  # memo partition/merge: exact contents
+    assert serial[4] == sharded[4]  # evaluated counts
+    if eps == 0.02:
+        # The truncated case must actually truncate, or it pins nothing.
+        full = _walk(1, prefix_mode, 1e-9)
+        assert serial[4] < full[4]
+
+
+def test_sharded_walk_chunk_not_dividing_block():
+    """A chunk below the prefix block (call width 2x5 sharded vs 5
+    serial; masked path padding + the cumsum group floor) keeps the
+    bit-identity contract — the width always scales by exactly D, so
+    per-device shapes stay the serial call's."""
+    for mode in ("cumsum", "masked"):
+        serial = _walk(1, mode, 1e-9, chunk=5)
+        sharded = _walk(2, mode, 1e-9, chunk=5)
+        np.testing.assert_array_equal(serial[0], sharded[0])
+        assert serial[3] == sharded[3]
+
+
+def test_eval_mesh_devices_capability():
+    """The capability resolution: single-host mesh shards, multihost and
+    single-device stay serial — and the evaluators the servers build
+    honor it (GTGShapley/MultiRoundShapley/the auditor all route
+    through eval_mesh_devices)."""
+    cfg = ExperimentConfig(worker_number=8, mesh_devices=2)
+    assert eval_mesh_devices(cfg) == 2
+    assert eval_mesh_devices(ExperimentConfig(worker_number=8)) is None
+    assert eval_mesh_devices(
+        ExperimentConfig(worker_number=8, mesh_devices=1)
+    ) is None
+    assert eval_mesh_devices(
+        ExperimentConfig(worker_number=8, mesh_devices=2, multihost=True)
+    ) is None
+    gtg = GTGShapley(
+        dataclasses.replace(cfg, distributed_algorithm="GTG_shapley_value")
+    )
+    gtg.prepare(None, _toy_eval)
+    assert gtg._evaluator.devices == 2
+    assert gtg._evaluator.call_width == 32  # 16 x D, 16 per device
+    assert gtg.shards_subset_eval
+
+
+# ---- end-to-end: the v10 record + the serial off-gate ----------------------
+
+
+def _gtg_run(tiny_config, **kw):
+    cfg = dataclasses.replace(
+        tiny_config, distributed_algorithm="GTG_shapley_value",
+        worker_number=8, round=1, round_trunc_threshold=0.0,
+        shapley_eval_samples=64, **kw,
+    )
+    return run_simulation(cfg, setup_logging=False)
+
+
+def test_end_to_end_mesh_records_v10(tiny_config):
+    """A mesh_devices=2 GTG run: SVs match the serial run to the
+    documented resident-vs-mesh tolerance (the TRAINED stack moves by
+    reduction order; the walk itself is bit-exact —
+    test_sharded_walk_bit_identical), the round record carries the
+    schema-v10 ``gtg`` sub-object (devices/evals_per_s/wave_width/
+    walk_seconds, validated against the checked-in schema), and the
+    SERIAL run's records stay pre-feature — no gtg key, no version
+    stamp (the off-gate discipline)."""
+    serial = _gtg_run(tiny_config)
+    sharded = _gtg_run(tiny_config, mesh_devices=2)
+    sv_s = serial["history"][0]["shapley_values"]
+    sv_m = sharded["history"][0]["shapley_values"]
+    np.testing.assert_allclose(
+        [sv_m[i] for i in sorted(sv_m)], [sv_s[i] for i in sorted(sv_s)],
+        atol=1e-4,
+    )
+    rec = sharded["history"][0]
+    assert rec["schema_version"] == 10
+    gtg = rec["gtg"]
+    assert gtg["devices"] == 2
+    assert gtg["wave_width"] == 32
+    assert gtg["walk_seconds"] > 0
+    _validate_record(rec)
+    # Serial off-gate: pre-feature record layout, byte-discipline kept.
+    assert "gtg" not in serial["history"][0]
+    assert "schema_version" not in serial["history"][0]
+    _validate_record(serial["history"][0])
+
+
+# ---- audits at production cadence on the mesh ------------------------------
+
+
+def test_audit_under_mesh_fidelity():
+    """The PR 9 graded-quality differential, now with the run — and the
+    audit walk — sharded over 2 devices (the previously-refused
+    combination): the streaming-vs-audit Spearman must still clear the
+    compare_bench floor (0.8), the audit record carries the walk's
+    device count, and the records validate."""
+    from distributed_learning_simulator_tpu.data.registry import get_dataset
+    from distributed_learning_simulator_tpu.simulator import (
+        build_client_data,
+    )
+    from distributed_learning_simulator_tpu.telemetry.valuation import (
+        grade_client_labels,
+    )
+
+    n, rounds = 8, 9
+    config = ExperimentConfig(
+        dataset_name="synthetic", model_name="mlp",
+        distributed_algorithm="fed", worker_number=n, round=rounds,
+        epoch=1, learning_rate=0.1, batch_size=32,
+        n_train=1024, n_test=2048, log_level="WARNING",
+        dataset_args={"difficulty": 0.5}, compilation_cache_dir=None,
+        client_stats="on", client_valuation="on",
+        valuation_audit_every=2, valuation_audit_permutations=500,
+        gtg_eps=1e-4, mesh_devices=2,
+    )
+    ds = get_dataset(
+        "synthetic", n_train=1024, n_test=2048, seed=0, difficulty=0.5
+    )
+    cd = build_client_data(config, ds)
+    cd.y[:] = grade_client_labels(cd.y, ds.num_classes, seed=1)
+    result = run_simulation(config, dataset=ds, client_data=cd,
+                            setup_logging=False)
+    audits = [
+        r["valuation"]["audit"] for r in result["history"]
+        if "audit" in r.get("valuation", {})
+    ]
+    assert len(audits) == 4  # rounds 2, 4, 6, 8
+    assert all(a["devices"] == 2 for a in audits)
+    assert result["valuation"]["last_audit"]["spearman"] >= 0.8
+    for r in result["history"]:
+        _validate_record(r)
+
+
+# ---- refusal causes: lifted vs kept ----------------------------------------
+
+
+def test_refusal_causes_lifted_and_kept():
+    """Single-host mesh + audits now validates (the lifted refusal);
+    multihost + audits keeps a cause-named refusal; and a SCHEDULED
+    sweep point carrying mesh + audits validates too — a sweep's audit
+    load spreads across the same mesh via the full-run fallback."""
+    audit_kw = dict(
+        worker_number=8, client_stats="on", client_valuation="on",
+        valuation_audit_every=2,
+    )
+    # Lifted: audits compose with single-host mesh sharding.
+    ExperimentConfig(mesh_devices=2, **audit_kw).validate()
+    # Kept, cause named: the audit walk is single-process host control
+    # flow.
+    with pytest.raises(ValueError, match="multihost"):
+        ExperimentConfig(multihost=True, **audit_kw).validate()
+    # Sweep composition: the scheduled strategy accepts the point (the
+    # Shapley SERVERS stay refused in sweeps — unchanged).
+    from distributed_learning_simulator_tpu.sweep.spec import SweepSpec
+
+    base = ExperimentConfig(
+        dataset_name="synthetic", model_name="mlp", round=2,
+        n_train=256, n_test=128, mesh_devices=2, **audit_kw,
+    )
+    SweepSpec(base, [{"seed": 0}, {"seed": 1}],
+              strategy="scheduled").validate()
+    with pytest.raises(ValueError, match="Shapley"):
+        SweepSpec(
+            dataclasses.replace(
+                ExperimentConfig(worker_number=8),
+                distributed_algorithm="GTG_shapley_value",
+            ),
+            [{"seed": 0}, {"seed": 1}],
+        ).validate()

@@ -5,11 +5,13 @@ tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle, sweep engine) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
 (+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel), v7
-(+valuation), v8 (+sweep) and v9 (+population) records must validate;
+(+valuation), v8 (+sweep), v9 (+population) and v10 (+gtg) records
+must validate;
 records that mix versions and sub-objects inconsistently must not. The
 integration tests in test_client_stats.py (test_costmodel.py for v6,
 test_valuation.py for v7, test_sweep.py for v8, test_population.py for
-v9) validate REAL produced records against the same file.
+v9, test_gtg_mesh.py for v10) validate REAL produced records against
+the same file.
 """
 
 import json
@@ -330,7 +332,7 @@ def test_v9_record_validates():
         _base(), _telemetry(), _client_stats(), _async(), _stream(),
         _costmodel(), _valuation(), _sweep(), _population(),
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 9
+    assert record["schema_version"] == 9
     validate(record)
     # population alone (every other feature off) is still v9 — a
     # dynamic-population run at default telemetry.
@@ -348,8 +350,50 @@ def test_v9_record_validates():
     ))
 
 
+def _gtg() -> dict:
+    return {
+        "devices": 2,
+        "evals_per_s": 1412.5,
+        "wave_width": 32,
+        "walk_seconds": 4.731,
+    }
+
+
+def test_v10_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), _client_stats(), _async(), _stream(),
+        _costmodel(), _valuation(), _sweep(), _population(), _gtg(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 10
+    validate(record)
+    # gtg alone (every other feature off) is still v10 — a mesh-sharded
+    # GTG run at default telemetry. (keep_client_params always leaves
+    # the shapley extras as base-record scalars, allowed in every
+    # version like the other algorithm extras.)
+    validate(build_round_record(
+        {**_base(), "gtg_permutations": 40, "gtg_subset_evals": 715,
+         "mean_client_loss": 1.2},
+        gtg=_gtg(),
+    ))
+    # A tiny walk can have no throughput sample (0 evals -> null rate).
+    validate(build_round_record(
+        _base(), gtg={**_gtg(), "evals_per_s": None}
+    ))
+    # The audit-side face: a v7 valuation audit carrying the walk's
+    # device count stays v7 (the gtg sub-object is the GTG server's
+    # per-round record, not the auditor's).
+    record = build_round_record(
+        _base(), None, None, None, None, None,
+        {**_valuation(), "audit": {
+            **_valuation()["audit"], "devices": 2,
+        }},
+    )
+    assert record["schema_version"] == 7
+    validate(record)
+
+
 def test_lowest_version_stamping_preserved():
-    """Adding v9 must not disturb the lower stamps: the version is the
+    """Adding v10 must not disturb the lower stamps: the version is the
     LOWEST that describes the record (longitudinal byte-identity)."""
     assert "schema_version" not in build_round_record(_base())
     assert build_round_record(_base(), _telemetry())[
@@ -366,6 +410,8 @@ def test_lowest_version_stamping_preserved():
                               _valuation())["schema_version"] == 7
     assert build_round_record(_base(), sweep=_sweep())[
         "schema_version"] == 8
+    assert build_round_record(_base(), population=_population())[
+        "schema_version"] == 9
 
 
 def test_version_content_mismatches_rejected():
@@ -514,6 +560,24 @@ def test_version_content_mismatches_rejected():
     )
     with pytest.raises(jsonschema.ValidationError):
         validate(bad)
+    # v9 stamp smuggling a gtg sub-object (the builder always stamps
+    # gtg records v10).
+    bad = build_round_record(_base(), population=_population())
+    bad["gtg"] = _gtg()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v10 stamp without the gtg sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 10
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown gtg keys — and a serial walk claiming the sub-object
+    # (devices < 2: serial rounds must keep pre-v10 records) — are
+    # schema breaks, not silent extensions.
+    for poison in ({"mystery": 1}, {"devices": 1}):
+        bad = build_round_record(_base(), gtg={**_gtg(), **poison})
+        with pytest.raises(jsonschema.ValidationError):
+            validate(bad)
 
 
 def test_missing_required_base_fields_rejected():
