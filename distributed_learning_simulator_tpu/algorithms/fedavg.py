@@ -614,7 +614,7 @@ class FedAvg(Algorithm):
 
         def cohort_round(global_params, state_k, x_k, y_k, m_k, part_sizes,
                          idx, key, keys, lr_scale, async_state,
-                         departed=None):
+                         departed=None, draw_pos=None):
             """The round body AFTER the cohort gather — shared verbatim by
             the resident entry (which gathered in-program) and the
             streamed entry (whose operands arrived pre-gathered from the
@@ -624,10 +624,23 @@ class FedAvg(Algorithm):
             ``new_state_k`` is cohort-sliced and NOT yet scattered.
             ``departed`` (bool[cohort]; population='dynamic' only) marks
             members that depart THIS round — zero contribution, counted
-            against the quorum floor."""
+            against the quorum floor. ``draw_pos`` (int[cohort];
+            multihost streamed residency only) says which DRAW position
+            the client at each cohort row came from: the distributed
+            shard store's owner-sharded assembly permutes the cohort
+            into owner-contiguous row groups (data/residency
+            .plan_owner_assembly), and permuting the per-POSITION draws
+            below (training keys, fault flags) by the same map keeps
+            every client's training bit-identical to the draw-order
+            program — only the aggregation's summation order moves,
+            which is the documented resident-vs-mesh tolerance."""
             _, train_key, payload_key, agg_key, fault_key = keys
             if fm is not None:
                 failed = fm.draw_failed(fault_key, n_participants)
+                if draw_pos is not None:
+                    # The fault stream is positional in DRAW order; the
+                    # client at row p sat at draw position draw_pos[p].
+                    failed = jnp.take(failed, draw_pos, axis=0)
                 survival = ~failed
             else:
                 failed = None
@@ -642,6 +655,11 @@ class FedAvg(Algorithm):
                     part_sizes.dtype
                 )
             client_keys = jax.random.split(train_key, n_participants)
+            if draw_pos is not None:
+                # Same permutation for the per-position training keys: the
+                # client at row p trains with the key of its draw
+                # position, exactly as in the draw-order program.
+                client_keys = client_keys[draw_pos]
             routed_late = None
             if failed is not None and fm.excludes_update:
                 if af is not None and fm.routes_to_buffer:
@@ -987,7 +1005,8 @@ class FedAvg(Algorithm):
 
         def round_fn_streamed(global_params, state_k, x_k, y_k, m_k,
                               part_sizes, idx, key, lr_scale=1.0,
-                              async_state=None, departed=None):
+                              async_state=None, departed=None,
+                              draw_pos=None):
             """Streamed calling convention (base.Algorithm docstring): the
             cohort slice arrives pre-gathered from the host shard store,
             ``idx`` is its true client ids (None = whole population), and
@@ -1018,6 +1037,7 @@ class FedAvg(Algorithm):
                 global_params, state_k, x_k, y_k, m_k, part_sizes, idx,
                 key, keys, lr_scale, async_state,
                 departed=departed if dyn else None,
+                draw_pos=draw_pos,
             )
             if idx is not None:
                 aux["participants"] = idx

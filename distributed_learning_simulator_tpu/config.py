@@ -380,8 +380,14 @@ class ExperimentConfig:
     # unchanged. vmap execution only; single-host mesh sharding
     # COMPOSES (the streamer uploads the cohort slice straight into the
     # client-axis PartitionSpec layout — the cohort must divide
-    # mesh_devices); refuses multihost (the host shard store is
-    # single-process) and algorithms that don't opt in
+    # mesh_devices), and so does MULTIHOST (the distributed shard
+    # store: each process owns an N/num_hosts client slice and serves
+    # its own members of every round's owner-permuted cohort straight
+    # into its addressable shards of the client-axis PartitionSpec —
+    # data/residency.py + parallel/streaming.py; needs a mesh spanning
+    # every process and the hashed sampler for sampled cohorts, with
+    # the remaining composition refusals cause-named in validate() and
+    # docs/ROBUSTNESS.md). Refuses algorithms that don't opt in
     # (Algorithm.supports_streamed_residency — the Shapley family's
     # subset re-evaluation assumes a resident stack).
     client_residency: str = "resident"
@@ -847,20 +853,110 @@ class ExperimentConfig:
                     "per-worker data)"
                 )
             if self.multihost:
-                # Single-host mesh sharding composes (the streamer
-                # uploads each cohort slice directly into the
-                # client-axis PartitionSpec layout — parallel/
-                # streaming.py); multi-HOST does not yet: the host
-                # shard store lives in ONE process's RAM, and every
-                # other process would need its cohort shard shipped
-                # over DCN each dispatch.
-                raise ValueError(
-                    "client_residency='streamed' does not compose with "
-                    "multihost: the host shard store is single-process "
-                    "(each remote host's cohort shard would cross DCN "
-                    "every dispatch); use client_residency='resident' "
-                    "with multihost, or streamed on one host's mesh"
-                )
+                # Streamed x multihost COMPOSES since the distributed
+                # shard store landed (data/residency.DistributedShardStore
+                # + parallel/streaming.DistributedCohortStreamer): each
+                # process owns an N/num_hosts client slice and serves its
+                # own cohort members straight into its addressable shards
+                # of the client-axis PartitionSpec — only the per-round
+                # ownership-imbalance spill (O(sqrt(cohort)) rows) ever
+                # crosses DCN. The refinements below are the remaining
+                # cause-named refusals (docs/ROBUSTNESS.md composition
+                # matrix).
+                if self.mesh_devices is None or self.mesh_devices < 2:
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "needs mesh_devices set to the GLOBAL device "
+                        "count: the distributed shard store serves each "
+                        "host's cohort members into its addressable "
+                        "shards of the client-axis PartitionSpec, so "
+                        "there must be a mesh spanning every process"
+                    )
+                if (
+                    self.participation_fraction < 1.0
+                    and self.participation_sampler.lower() != "hashed"
+                ):
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "requires participation_sampler='hashed' for "
+                        "sampled cohorts: every host replays the full "
+                        "cohort independently each round, and only the "
+                        "O(cohort) hashed draw keeps that replay free "
+                        "at million-client populations (the exact "
+                        "sampler pays an O(N log N) permutation PER "
+                        "HOST per round)"
+                    )
+                if self.rounds_per_dispatch > 1:
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "requires rounds_per_dispatch=1: a fused "
+                        "K-round dispatch would need K owner-sharded "
+                        "assemblies and spill exchanges inside one "
+                        "program, which the host-side exchange cannot "
+                        "serve mid-dispatch"
+                    )
+                if (
+                    self.distributed_algorithm == "fed_quant"
+                    and self.participation_fraction < 1.0
+                ):
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "does not compose with fed_quant at sampled "
+                        "cohorts: its uplink stochastic-quantization "
+                        "keys split per cohort ROW, so the "
+                        "owner-permuted layout would dither each "
+                        "client's upload with a different key than "
+                        "the 1-process run (silently breaking the "
+                        "per-client bit-identity contract the "
+                        "draw_pos operand provides for training "
+                        "draws); use participation_fraction=1, plain "
+                        "'fed', or client_residency='resident'"
+                    )
+                if self.async_mode.lower() == "on":
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "does not compose with async_mode='on': the "
+                        "staleness buffer's late-upload row has been "
+                        "validated on single-host meshes only; use "
+                        "client_residency='resident' for async "
+                        "multihost runs"
+                    )
+                if self.client_stats.lower() == "on":
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "does not compose with client_stats='on': the "
+                        "per-client stats matrix is client-axis sharded "
+                        "across processes and the host-side detector "
+                        "fetch would need a cross-host gather every "
+                        "round; use resident multihost for client stats"
+                    )
+                if self.client_valuation.lower() == "on":
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "does not compose with client_valuation='on': "
+                        "the streaming valuation vector is a full-N "
+                        "host array with ONE owner, which the "
+                        "host-sharded store deliberately no longer has"
+                    )
+                if self.participation_fraction >= 1.0 and (
+                    (
+                        self.distributed_algorithm == "sign_SGD"
+                        and self.momentum != 0.0
+                    )
+                    or not self.reset_client_optimizer
+                ):
+                    raise ValueError(
+                        "client_residency='streamed' under multihost "
+                        "does not compose with persistent per-client "
+                        "state at full participation (momentum "
+                        "sign_SGD / reset_client_optimizer=False): the "
+                        "full-population state stack stays "
+                        "device-resident across rounds, which the "
+                        "per-host store cannot checkpoint-own; sampled "
+                        "cohorts (participation_fraction < 1) carry "
+                        "state through the owner exchange, or use "
+                        "client_residency='resident'"
+                    )
         if self.population.lower() not in POPULATION_MODES:
             raise ValueError(
                 f"unknown population {self.population!r}; known: "
@@ -931,6 +1027,14 @@ class ExperimentConfig:
                     " registration events (joins/departures/drift) apply "
                     "at host round boundaries, which a fused K-round "
                     "scan dispatch does not expose"
+                )
+            if self.multihost:
+                raise ValueError(
+                    "population='dynamic' does not compose with "
+                    "multihost: joins grow the store and would "
+                    "re-partition the distributed shard store's "
+                    "ownership bounds mid-run; run dynamic populations "
+                    "on one host's mesh"
                 )
             if self.async_mode.lower() == "on":
                 raise ValueError(

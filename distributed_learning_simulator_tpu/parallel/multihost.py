@@ -11,6 +11,7 @@ ICI within a slice and DCN across slices — no separate code path.
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 from distributed_learning_simulator_tpu.utils.logging import get_logger
 
@@ -19,6 +20,29 @@ from distributed_learning_simulator_tpu.utils.logging import get_logger
 # API doesn't expose it, so remember it to catch a re-call that names a
 # DIFFERENT coordinator while counts happen to match.
 _initialized_coordinator: str | None = None
+
+
+def distributed_initialized() -> bool:
+    """Whether jax.distributed is up in this process.
+
+    ``jax.distributed.is_initialized`` exists only in some jax
+    versions; where it is absent, the presence of the distributed
+    coordination client (the state ``jax.distributed.initialize``
+    creates) is the same fact.
+    """
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        state = _dist.global_state
+        return (
+            getattr(state, "client", None) is not None
+            or getattr(state, "service", None) is not None
+        )
+    except Exception:  # pragma: no cover - exotic jax builds
+        return False
 
 
 def initialize_multihost(
@@ -45,7 +69,7 @@ def initialize_multihost(
         v is not None
         for v in (coordinator_address, num_processes, process_id)
     )
-    if jax.distributed.is_initialized():
+    if distributed_initialized():
         # Safe to re-call in an already-distributed process (a second
         # run_simulation in the same driver, a retry) — but explicit flags
         # must MATCH the live topology: reusing a single-process runtime
@@ -87,6 +111,21 @@ def initialize_multihost(
         logger.info("jax.distributed already initialized; reusing it")
     else:
         try:
+            # CPU backend (tests, CPU clusters): cross-process
+            # computations need a CPU collectives implementation —
+            # without one, the first sharded dispatch dies with
+            # "Multiprocess computations aren't implemented on the CPU
+            # backend". Gloo ships in jaxlib; the knob must be set
+            # BEFORE the backend initializes, which this call precedes
+            # by contract (it runs before any device query). Guarded:
+            # absent on exotic builds, and a no-op for TPU/GPU (their
+            # collectives ride ICI/NCCL regardless).
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except (AttributeError, ValueError):
+                pass
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
@@ -115,3 +154,43 @@ def initialize_multihost(
         jax.process_index(), jax.process_count(), n,
     )
     return n
+
+
+def mesh_devices_per_host(mesh) -> list[int]:
+    """Per-process device counts of a 1-D mesh, validated for the
+    distributed shard store's contiguous-block layout.
+
+    The owner-sharded cohort assembly (data/residency.plan_owner_assembly
+    + parallel/streaming.DistributedCohortStreamer) needs each host's
+    addressable shards of the client-axis ``PartitionSpec`` to be ONE
+    contiguous row block, which holds exactly when the mesh's device
+    order groups processes contiguously (true for ``jax.devices()`` on
+    every backend — devices sort by process index — but verified here
+    rather than assumed). Also requires the mesh to span EVERY process:
+    a process with no addressable mesh device could never serve its
+    owned clients' rows. Returns ``devices_per_host`` indexed by process
+    id — the input :func:`data.residency.host_axis_bounds` turns into
+    ownership/block boundaries.
+    """
+    procs = [d.process_index for d in np.ravel(mesh.devices)]
+    n_hosts = jax.process_count()
+    if sorted(procs) != procs:
+        raise ValueError(
+            "mesh device order interleaves processes "
+            f"(process sequence {procs}); the distributed shard store "
+            "needs each host's mesh shards contiguous — build the mesh "
+            "from jax.devices() order"
+        )
+    counts = [0] * n_hosts
+    for p in procs:
+        counts[p] += 1
+    missing = [h for h, c in enumerate(counts) if c == 0]
+    if missing:
+        raise ValueError(
+            f"mesh spans {len(set(procs))} of {n_hosts} processes "
+            f"(processes {missing} contribute no device); "
+            "client_residency='streamed' under multihost needs every "
+            "host addressable in the mesh — set mesh_devices to the "
+            "global device count"
+        )
+    return counts

@@ -92,6 +92,7 @@ from distributed_learning_simulator_tpu.utils.reporting import (
 from distributed_learning_simulator_tpu.utils.errors import is_device_oom
 from distributed_learning_simulator_tpu.utils.checkpoint import (
     gc_checkpoints,
+    latest_checkpoint,
     load_latest_valid_checkpoint,
     save_checkpoint,
 )
@@ -669,6 +670,45 @@ def run_simulation(
     # the resident program shape (HBM already sizes by the cohort).
     stream_sampled = streamed and cohort_n < n_clients
     stream_full = streamed and not stream_sampled
+    # Distributed shard store (streamed x multihost; data/residency.py +
+    # parallel/streaming.DistributedCohortStreamer): with >1 host
+    # process, each process owns an N/num_hosts client slice and serves
+    # its own members of every round's owner-permuted cohort straight
+    # into its addressable shards of the client-axis PartitionSpec.
+    # Everything below is gated on mh, so a single process — including
+    # multihost=True in a 1-process environment — runs the exact
+    # single-host streamed path (the num_hosts==1 zero-cost contract).
+    n_procs = jax.process_count()
+    mh = streamed and config.multihost and n_procs > 1
+    mh_mesh = None
+    mh_owner_bounds = None
+    mh_block_bounds = None
+    if mh:
+        from distributed_learning_simulator_tpu.data.residency import (
+            host_axis_bounds,
+        )
+        from distributed_learning_simulator_tpu.parallel.multihost import (
+            mesh_devices_per_host,
+        )
+
+        # The mesh is needed BEFORE placement here: ownership bounds
+        # derive from its per-host device split, and the sharded-
+        # checkpoint resume path validates the manifest against them.
+        mh_mesh = make_mesh(config.mesh_devices)
+        devs_per_host = mesh_devices_per_host(mh_mesh)
+        mh_owner_bounds = host_axis_bounds(n_clients, devs_per_host)
+        if stream_sampled:
+            if cohort_n % config.mesh_devices != 0:
+                raise ValueError(
+                    "cohort size (participation_fraction x "
+                    f"worker_number) ({cohort_n}) must be a multiple "
+                    f"of mesh_devices ({config.mesh_devices})"
+                )
+            mh_block_bounds = host_axis_bounds(cohort_n, devs_per_host)
+        else:
+            # Full-cohort regime: the upload axis IS the client axis, so
+            # ownership bounds and block bounds coincide.
+            mh_block_bounds = mh_owner_bounds
     # Open-world population (config.population; robustness/population.py):
     # None at the 'static' default — the exact pre-feature path. Under
     # 'dynamic' the registration stream owns joins/departures/drift; the
@@ -816,8 +856,17 @@ def run_simulation(
     if streamed:
         # Host-side init: the full-N state tree must never be built as a
         # device stack (that allocation is what streamed mode removes).
+        # Under the distributed store each host initializes ONLY the
+        # rows it owns — per-host state RAM scales as N/num_hosts like
+        # the data shards (every init row is identical, so the sliced
+        # init equals the full init's slice by construction).
+        _n_state = (
+            int(mh_owner_bounds[jax.process_index() + 1]
+                - mh_owner_bounds[jax.process_index()])
+            if mh else n_clients
+        )
         client_state = _host_client_state(
-            algorithm, optimizer, global_params, n_clients
+            algorithm, optimizer, global_params, _n_state
         )
     else:
         client_state = algorithm.init_client_state(
@@ -830,10 +879,63 @@ def run_simulation(
         async_ctl.init_state(global_params) if async_ctl is not None else None
     )
     if config.resume and config.checkpoint_dir:
-        # Integrity-verified discovery: a corrupt/truncated latest
-        # checkpoint (CRC mismatch) is skipped with a warning and resume
-        # falls back to the newest VALID one instead of crashing.
-        ckpt_path, ckpt = load_latest_valid_checkpoint(config.checkpoint_dir)
+        from distributed_learning_simulator_tpu.utils.checkpoint import (
+            load_latest_valid_sharded_checkpoint,
+            manifest_rounds,
+            validate_manifest,
+        )
+
+        if mh:
+            # Per-host shards + manifest (utils/checkpoint.py): each
+            # process restores its OWN shard; the manifest commits the
+            # round and records the topology the shards were cut for.
+            # The shard payload carries the same keys as a whole
+            # checkpoint, so every structure/config check below runs
+            # unchanged on it.
+            manifest, ckpt = load_latest_valid_sharded_checkpoint(
+                config.checkpoint_dir, jax.process_index(), n_procs
+            )
+            if manifest is not None:
+                validate_manifest(
+                    manifest, n_hosts=n_procs, n_clients=n_clients,
+                    owner_bounds=mh_owner_bounds,
+                )
+                # The agreement check below hashes the MANIFEST name
+                # (identical across hosts); shard basenames differ per
+                # host by construction.
+                ckpt_path = os.path.join(
+                    config.checkpoint_dir,
+                    f"round_{manifest['round']}.manifest.json",
+                )
+            else:
+                ckpt_path = None
+                if latest_checkpoint(config.checkpoint_dir):
+                    raise RuntimeError(
+                        "multihost streamed resume found only a "
+                        "single-file checkpoint in "
+                        f"{config.checkpoint_dir!r}: it was written by "
+                        "a single-process run and cannot be re-split "
+                        "into per-host shards; resume it on the "
+                        "topology it was written with"
+                    )
+        else:
+            # Integrity-verified discovery: a corrupt/truncated latest
+            # checkpoint (CRC mismatch) is skipped with a warning and
+            # resume falls back to the newest VALID one instead of
+            # crashing.
+            ckpt_path, ckpt = load_latest_valid_checkpoint(
+                config.checkpoint_dir
+            )
+            if ckpt_path is None and manifest_rounds(config.checkpoint_dir):
+                raise RuntimeError(
+                    f"checkpoint dir {config.checkpoint_dir!r} holds "
+                    "per-host sharded checkpoints (a multihost streamed "
+                    "run wrote them); resume under the multihost "
+                    "streamed topology they were written with — this "
+                    "run is "
+                    + ("multihost resident"
+                       if config.multihost else "single-process")
+                )
         if ckpt_path:
             resumed_basename = os.path.basename(ckpt_path)
             want_gp = jax.tree_util.tree_structure(global_params)
@@ -989,7 +1091,9 @@ def run_simulation(
     startup_stream = {"rec": None}  # stream_full's one-shot upload record
     eval_batches = tuple(jnp.asarray(a) for a in eval_batches_np)
     if config.mesh_devices and config.mesh_devices > 1:
-        mesh = make_mesh(config.mesh_devices)
+        mesh = mh_mesh if mh_mesh is not None else make_mesh(
+            config.mesh_devices
+        )
         # The DEVICE-resident client-axis length must split evenly over
         # the mesh: the whole population when resident (or full-cohort
         # streamed — the startup upload IS population-shaped), but only
@@ -1022,7 +1126,53 @@ def run_simulation(
             np.array(client_data.y, copy=True) if pop is not None
             else client_data.y
         )
-        if pop is not None and resumed_population is not None:
+        if mh:
+            from distributed_learning_simulator_tpu.data.residency import (
+                DistributedShardStore,
+            )
+            from distributed_learning_simulator_tpu.parallel.streaming import (
+                DistributedCohortStreamer,
+            )
+
+            # Owner-sharded store: this process keeps ONLY its owned
+            # client slice (constructor copies it out of the full-N
+            # view every process derives from the deterministic
+            # partition); the streamer serves those members straight
+            # into this host's addressable shards of the client-axis
+            # PartitionSpec. config.validate() pinned the composition
+            # (hashed sampler for sampled cohorts, no dynamic
+            # population / client_stats / valuation / async / K>1).
+            store = DistributedShardStore(
+                client_data.x, _pop_y, client_data.mask,
+                client_data.sizes,
+                state=client_state if stream_sampled else None,
+                host_id=jax.process_index(),
+                owner_bounds=mh_owner_bounds,
+            )
+            streamer = DistributedCohortStreamer(
+                store, algorithm, n_clients, mh_mesh, mh_block_bounds
+            )
+            if stream_full:
+                (cx, cy, cmask, _szs, _full_idx), startup_stream["rec"] = (
+                    streamer.upload_full()
+                )
+                # sizes stays a host value: the mesh block below
+                # replicates it like the resident multihost path (a
+                # host array is placeable into a global sharding; the
+                # upload's client-sharded sizes array is not
+                # re-placeable cross-process).
+                sizes = client_data.sizes
+            else:
+                cx = cy = cmask = None
+                sizes = client_data.sizes
+                client_state = None
+                logger.info(
+                    "distributed shard store: host %d/%d owns %d of %d "
+                    "clients (%.2f GB shard), cohort %d per dispatch",
+                    store.host_id, store.n_hosts, store.n_owned,
+                    n_clients, store.data_bytes() / 2**30, cohort_n,
+                )
+        elif pop is not None and resumed_population is not None:
             # Resume mid-growth: the store starts at the startup
             # population (re-derived from the dataset partition), the
             # registration state grows it by the checkpointed joined
@@ -1047,28 +1197,32 @@ def run_simulation(
                 client_data.sizes,
                 state=client_state if stream_sampled else None,
             )
-        streamer = CohortStreamer(store, algorithm, n_clients, mesh=mesh)
-        if stream_full:
-            (cx, cy, cmask, sizes, _full_idx), startup_stream["rec"] = (
-                streamer.upload_full()
-            )
-            if client_state is not None:
-                # Full-cohort state lives on device across rounds exactly
-                # like resident (the whole population IS the cohort); it
-                # is a donated round_jit operand, so copy on placement.
-                client_state = _owned_device_tree(client_state)
-        else:
-            # Sampled regime: no full-N device arrays exist; the cohort
-            # slices are per-dispatch operands. The loop's client_state
-            # stays None — the store owns the state between dispatches.
-            cx = cy = cmask = None
-            sizes = jnp.asarray(client_data.sizes)
-            client_state = None
-            logger.info(
-                "client_residency='streamed': %d clients host-resident "
-                "(%.2f GB), cohort %d per dispatch",
-                n_clients, store.data_bytes() / 2**30, cohort_n,
-            )
+        if not mh:
+            streamer = CohortStreamer(store, algorithm, n_clients,
+                                      mesh=mesh)
+            if stream_full:
+                (cx, cy, cmask, sizes, _full_idx), startup_stream["rec"] = (
+                    streamer.upload_full()
+                )
+                if client_state is not None:
+                    # Full-cohort state lives on device across rounds
+                    # exactly like resident (the whole population IS the
+                    # cohort); it is a donated round_jit operand, so
+                    # copy on placement.
+                    client_state = _owned_device_tree(client_state)
+            else:
+                # Sampled regime: no full-N device arrays exist; the
+                # cohort slices are per-dispatch operands. The loop's
+                # client_state stays None — the store owns the state
+                # between dispatches.
+                cx = cy = cmask = None
+                sizes = jnp.asarray(client_data.sizes)
+                client_state = None
+                logger.info(
+                    "client_residency='streamed': %d clients "
+                    "host-resident (%.2f GB), cohort %d per dispatch",
+                    n_clients, store.data_bytes() / 2**30, cohort_n,
+                )
     else:
         data_arrays = (
             jnp.asarray(client_data.x), jnp.asarray(client_data.y),
@@ -1113,8 +1267,14 @@ def run_simulation(
     # nor when checkpointing needs per-client or server-optimizer state (those
     # buffers are donated to round r+1's dispatch before round r's deferred
     # checkpoint would read them).
+    # Sharded checkpoints (distributed shard store): EVERY process
+    # writes its own shard — only the manifest commit (and the legacy
+    # single-file path) stays primary-only — so the flag must agree
+    # across hosts (it also feeds the pipelining decision, which under
+    # SPMD must resolve identically on every process).
     checkpointing = bool(
-        config.checkpoint_dir and config.checkpoint_every and is_primary
+        config.checkpoint_dir and config.checkpoint_every
+        and (is_primary or mh)
     )
     # Round batching (config.rounds_per_dispatch > 1): K rounds fuse into
     # one scan dispatch with one metric fetch each; pipelining's
@@ -1282,9 +1442,79 @@ def run_simulation(
             cost_ledger = None
     telemetry["costmodel"] = None
 
+    def _save_sharded_checkpoint(round_idx, new_global, client_state_rows,
+                                 algo_state, rng_key) -> None:
+        """Per-host checkpoint shards + manifest (distributed shard
+        store; utils/checkpoint.py). EVERY process writes its shard —
+        its owned per-client state slice plus the replicated global
+        state, so each shard restores its process without cross-host
+        reads — then all processes barrier on the round (the shard
+        allgather doubles as the agreement check) and process 0 commits
+        the round by writing the manifest. A host that dies between its
+        shard write and the barrier leaves the round manifest-less:
+        resume falls back one checkpoint interval, the torn-write
+        discipline at shard granularity."""
+        from jax.experimental import multihost_utils
+
+        from distributed_learning_simulator_tpu.utils.checkpoint import (
+            gc_sharded_checkpoints,
+            save_shard_checkpoint,
+            shard_checkpoint_path,
+            write_manifest,
+        )
+
+        pid = jax.process_index()
+        save_shard_checkpoint(
+            config.checkpoint_dir, round_idx, pid, n_procs,
+            {
+                "global_params": jax.device_get(new_global),
+                "client_state": (
+                    None if client_state_rows is None
+                    else jax.tree_util.tree_map(
+                        np.asarray, client_state_rows
+                    )
+                ),
+                "algo_state": algo_state,
+                "rng_key": jax.device_get(
+                    jax.random.key_data(rng_key)
+                ),
+            },
+        )
+        agreed = multihost_utils.process_allgather(
+            np.asarray([round_idx], dtype=np.int64)
+        )
+        if not (agreed == round_idx).all():
+            rounds_seen = agreed.ravel().tolist()
+            raise RuntimeError(
+                "sharded checkpoint barrier disagreement: processes "
+                f"are checkpointing different rounds ({rounds_seen}) — "
+                "SPMD round sequencing diverged"
+            )
+        if is_primary:
+            write_manifest(
+                config.checkpoint_dir, round_idx,
+                {
+                    "n_hosts": n_procs,
+                    "n_clients": n_clients,
+                    "owner_bounds": [int(b) for b in mh_owner_bounds],
+                    "cohort": cohort_n,
+                    "mesh_devices": int(config.mesh_devices),
+                    "shards": [
+                        os.path.basename(shard_checkpoint_path(
+                            config.checkpoint_dir, round_idx, h, n_procs
+                        ))
+                        for h in range(n_procs)
+                    ],
+                },
+            )
+            gc_sharded_checkpoints(
+                config.checkpoint_dir, config.checkpoint_keep_last
+            )
+
     def emit_record(round_idx, metrics, fetched_loss, fetched_tel, ctx,
                     tel_rec_fn, phase_round=None, stream_rec=None,
-                    audit_fn=None, population_rec=None):
+                    audit_fn=None, population_rec=None,
+                    multihost_rec=None):
         """Build + persist ONE round's metrics record from already-fetched
         host values: post_round hook, record assembly, quorum/cohort
         telemetry accumulation, client-stats detection, history append +
@@ -1459,10 +1689,12 @@ def run_simulation(
             or async_rec is not None or stream_rec is not None
             or cm_rec is not None or val_rec is not None
             or pop_rec is not None or gtg_rec is not None
+            or multihost_rec is not None
         ):
             record = build_round_record(
                 record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec,
                 val_rec, population=pop_rec, gtg=gtg_rec,
+                multihost=multihost_rec,
             )
         history.append(record)
         if metrics_path:
@@ -1509,6 +1741,17 @@ def run_simulation(
                   for k in tel_keys + cs_keys + val_keys + async_keys})
             )
         metrics = {k: float(v) for k, v in fetched_metrics.items()}
+        if p.get("participants_host") is not None and (
+            "participants" in fetched_tel
+        ):
+            # Distributed cohort assembly: the device operand carries the
+            # OWNER-permuted cohort (row order = placement order); the
+            # record's cohort_hash must stay comparable across
+            # topologies, so substitute the host-replayed DRAW-order
+            # cohort — same set, canonical order. Safe because the only
+            # consumer left under multihost streamed is the hash
+            # (client_stats/valuation are cause-named refusals there).
+            fetched_tel["participants"] = p["participants_host"]
         ctx = RoundContext(
             round_idx=p["round_idx"],
             global_params=p["new_global"],
@@ -1580,31 +1823,41 @@ def run_simulation(
             p["round_idx"], metrics, fetched_loss, fetched_tel, ctx,
             tel_rec_fn, stream_rec=p.get("stream"), audit_fn=audit_fn,
             population_rec=p.get("population"),
+            multihost_rec=p.get("multihost"),
         )
 
         if (
             checkpointing
             and (p["round_idx"] + 1) % config.checkpoint_every == 0
         ):
-            save_checkpoint(
-                os.path.join(
-                    config.checkpoint_dir, f"round_{p['round_idx']}.ckpt"
-                ),
-                p["round_idx"], p["new_global"], p["client_state"],
-                _algo_checkpoint_state(
-                    algorithm, metrics, p["server_state"],
-                    p.get("async_state"),
-                    vstate.values if vstate is not None else None,
-                    # Population events for this round were applied
-                    # before finalize (pipelining is off under dynamic),
-                    # so the snapshot is exactly the state the NEXT
-                    # round draws from.
-                    pop.checkpoint_state(store) if pop is not None
-                    else None,
-                ),
-                p["key"],
+            algo_state = _algo_checkpoint_state(
+                algorithm, metrics, p["server_state"],
+                p.get("async_state"),
+                vstate.values if vstate is not None else None,
+                # Population events for this round were applied
+                # before finalize (pipelining is off under dynamic),
+                # so the snapshot is exactly the state the NEXT
+                # round draws from.
+                pop.checkpoint_state(store) if pop is not None
+                else None,
             )
-            gc_checkpoints(config.checkpoint_dir, config.checkpoint_keep_last)
+            if mh:
+                _save_sharded_checkpoint(
+                    p["round_idx"], p["new_global"], p["client_state"],
+                    algo_state, p["key"],
+                )
+            else:
+                save_checkpoint(
+                    os.path.join(
+                        config.checkpoint_dir,
+                        f"round_{p['round_idx']}.ckpt"
+                    ),
+                    p["round_idx"], p["new_global"], p["client_state"],
+                    algo_state,
+                    p["key"],
+                )
+                gc_checkpoints(config.checkpoint_dir,
+                               config.checkpoint_keep_last)
         # Chaos-harness hook (robustness/chaos.py): inert unless
         # DLS_CRASH_AT_ROUND is set. Placed after the checkpoint block so
         # an injected crash models "the process died right after round N
@@ -2045,6 +2298,8 @@ def run_simulation(
                         )
                         stream_rec = None
                         pop_rec = None
+                        mh_rec = None
+                        mh_plan = None
                         if stream_sampled:
                             # Streamed dispatch: cohort slices arrive as
                             # pre-gathered operands (prefetched while the
@@ -2089,31 +2344,60 @@ def run_simulation(
                             else:
                                 # First round / resume: the draw is not
                                 # hidden behind a prior dispatch — its
-                                # own `sample` phase window.
+                                # own `sample` phase window (under the
+                                # distributed store this window also
+                                # covers the owner assembly + spill
+                                # exchange).
                                 with phase_timer.phase(
                                         round_idx, "sample"):
                                     idx_np = streamer.cohort_for(
                                         round_key
                                     )
+                                    if mh:
+                                        idx_np = streamer.plan(idx_np)
                             stream_next_idx = None
-                            (sx, sy, sm, ssz, sidx), stream_rec = (
-                                streamer.acquire([idx_np])
-                            )
+                            if mh:
+                                # Owner-sharded assembly: this host's
+                                # block rows, with ownership-imbalance
+                                # spill already exchanged at plan time;
+                                # the upload adds the draw_pos operand
+                                # that maps rows back to draw order.
+                                mh_plan = idx_np
+                                (
+                                    (sx, sy, sm, ssz, sidx, sdpos),
+                                    stream_rec, mh_plan,
+                                ) = streamer.acquire_plan(mh_plan)
+                                mh_kw = {"draw_pos": sdpos}
+                            else:
+                                (sx, sy, sm, ssz, sidx), stream_rec = (
+                                    streamer.acquire([idx_np])
+                                )
+                                mh_kw = {}
                             state_k = None
                             if store.state is not None:
-                                # Donated operand: owned buffers, not a
-                                # zero-copy view of the numpy gather.
-                                state_k = _owned_device_tree(
-                                    algorithm.gather_client_state(
-                                        store, idx_np
+                                if mh:
+                                    # Owner-assembled block state (own
+                                    # rows local, spill rows exchanged),
+                                    # placed straight into the
+                                    # client-axis layout.
+                                    state_k = streamer.gather_state_device(
+                                        mh_plan
                                     )
-                                )
-                                if mesh is not None:
-                                    # Cohort state joins the cohort
-                                    # slice's client-axis layout.
-                                    state_k = shard_client_data(
-                                        state_k, mesh
+                                else:
+                                    # Donated operand: owned buffers,
+                                    # not a zero-copy view of the numpy
+                                    # gather.
+                                    state_k = _owned_device_tree(
+                                        algorithm.gather_client_state(
+                                            store, idx_np
+                                        )
                                     )
+                                    if mesh is not None:
+                                        # Cohort state joins the cohort
+                                        # slice's client-axis layout.
+                                        state_k = shard_client_data(
+                                            state_k, mesh
+                                        )
                             dyn_kw = (
                                 {"departed": jnp.asarray(dep_mask)}
                                 if pop is not None else {}
@@ -2124,6 +2408,7 @@ def run_simulation(
                                     global_params, state_k, sx, sy, sm,
                                     ssz, sidx, round_key,
                                     *lr_args, **async_kw, **dyn_kw,
+                                    **mh_kw,
                                 )
                                 # Prefetch the next round's cohort while
                                 # this dispatch computes (the upload runs
@@ -2142,20 +2427,50 @@ def run_simulation(
                                     round_idx + 1 < config.round
                                 ) and not preempt["flag"]:
                                     _, _nxt_rk = jax.random.split(key)
-                                    stream_next_idx = streamer.cohort_for(
-                                        _nxt_rk
-                                    )
-                                    phase_timer.carve(
-                                        round_idx, "sample",
-                                        streamer.last_sample_seconds,
-                                        "client_step",
-                                    )
-                                    streamer.prefetch([stream_next_idx])
+                                    if mh:
+                                        # Plan (incl. the collective
+                                        # spill exchange) on the MAIN
+                                        # thread at the same loop point
+                                        # on every host — collective
+                                        # launch order stays identical
+                                        # across processes; only the
+                                        # device_put assembly rides the
+                                        # worker thread.
+                                        _t_s = time.perf_counter()
+                                        stream_next_idx = streamer.plan(
+                                            streamer.cohort_for(_nxt_rk)
+                                        )
+                                        phase_timer.carve(
+                                            round_idx, "sample",
+                                            time.perf_counter() - _t_s,
+                                            "client_step",
+                                        )
+                                        streamer.prefetch_plan(
+                                            stream_next_idx
+                                        )
+                                    else:
+                                        stream_next_idx = (
+                                            streamer.cohort_for(_nxt_rk)
+                                        )
+                                        phase_timer.carve(
+                                            round_idx, "sample",
+                                            streamer.last_sample_seconds,
+                                            "client_step",
+                                        )
+                                        streamer.prefetch(
+                                            [stream_next_idx]
+                                        )
                                 _ph.fence((new_global, aux))
                             # Host store is the source of truth between
                             # dispatches: checkpoint/resume read it.
-                            streamer.writeback(idx_np, new_state_k,
-                                               stream_rec)
+                            streamer.writeback(
+                                mh_plan if mh else idx_np, new_state_k,
+                                stream_rec,
+                            )
+                            if mh:
+                                mh_rec = streamer.multihost_record(
+                                    mh_plan, stream_rec or {}
+                                )
                             if pop is not None:
                                 # Registration events apply at the round
                                 # boundary, after the writeback and
@@ -2180,6 +2495,14 @@ def run_simulation(
                                 # the first round's record.
                                 stream_rec = startup_stream["rec"]
                                 startup_stream["rec"] = None
+                            if mh:
+                                # Full-cohort distributed upload: shard
+                                # provenance on every round's record
+                                # (spill is structurally zero — owner
+                                # bounds ARE the device blocks).
+                                mh_rec = streamer.multihost_record(
+                                    None, stream_rec or {}
+                                )
                             with phase_timer.phase(
                                     round_idx, "client_step") as _ph:
                                 new_global, client_state, aux = round_jit(
@@ -2240,6 +2563,13 @@ def run_simulation(
                         "async_state": async_state,
                         "stream": stream_rec,
                         "population": pop_rec,
+                        "multihost": mh_rec,
+                        # Draw-order cohort for the record's cohort_hash
+                        # (the device operand is owner-permuted under
+                        # the distributed layout).
+                        "participants_host": (
+                            mh_plan.idx if mh_plan is not None else None
+                        ),
                     }
                     global_params = new_global
                     if pipelined:
@@ -2285,7 +2615,20 @@ def run_simulation(
         # finalized above; persist it even off the checkpoint_every
         # cadence so the resumed run loses nothing, then exit cleanly.
         preempted_at = completed_round
-        if (
+        if mh and config.checkpoint_dir:
+            # No off-cadence force-write under the distributed store:
+            # the sharded commit needs a cross-host barrier, and SIGTERM
+            # delivery is per-process — a host whose peer never got the
+            # signal would block in the barrier instead of exiting. The
+            # checkpoint_every cadence (whose barrier every host
+            # reaches by SPMD construction) is the durability contract.
+            logger.warning(
+                "preempted at round %d (SIGTERM): sharded checkpoints "
+                "persist on the checkpoint_every cadence only (last "
+                "committed manifest is the resume point); exiting "
+                "cleanly", completed_round,
+            )
+        elif (
             config.checkpoint_dir and is_primary
             and completed_round >= start_round
         ):
@@ -2405,6 +2748,30 @@ def run_simulation(
         "stream_sample_seconds": (
             streamer.totals["sample_seconds"]
             if streamer is not None else None
+        ),
+        # Distributed shard store (streamed x multihost;
+        # parallel/streaming.DistributedCohortStreamer): this host's
+        # ownership summary and the run-total assembly traffic — spill
+        # rows (the per-round ownership imbalance) and the bytes they
+        # moved over DCN. None on single-process runs, the off-gate
+        # convention.
+        "stream_dcn_bytes": (
+            streamer.totals.get("dcn_bytes") if mh else None
+        ),
+        "multihost_summary": (
+            {
+                "hosts": n_procs,
+                "host_id": jax.process_index(),
+                "owned_clients": store.n_owned,
+                "shard_bytes": int(
+                    store.data_bytes()
+                    + (store.state_bytes()
+                       if store.state is not None else 0)
+                ),
+                "spill_rows": int(streamer.totals.get("spill_rows", 0)),
+                "dcn_bytes": int(streamer.totals.get("dcn_bytes", 0)),
+            }
+            if mh else None
         ),
         # Predictive cost model (telemetry/costmodel.py): the schema-v6
         # costmodel sub-object the run's last record carried — None when

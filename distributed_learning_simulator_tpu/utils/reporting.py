@@ -42,7 +42,13 @@ import json
 # mesh-sharded GTG walk's provenance: devices the subset-evaluation
 # batch axis partitioned over, subset-eval throughput, the fused-call
 # wave width, and the walk's wall seconds; algorithms/shapley.py —
-# attached only on rounds whose walk actually sharded). A record
+# attached only on rounds whose walk actually sharded). v11 adds the
+# ``multihost`` sub-object (the distributed shard store's per-host
+# assembly provenance: host count, this host's id/owned-client
+# count/shard bytes, the round's spill rows + bytes over DCN, and this
+# host's h2d/overlap; parallel/streaming.DistributedCohortStreamer —
+# attached only under client_residency='streamed' with >1 host
+# process). A record
 # is stamped with the LOWEST version that describes it:
 # telemetry_level='off' keeps emitting v1 byte-for-byte,
 # client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
@@ -50,11 +56,13 @@ import json
 # 'resident' keeps records at v4 or below, cost_model_trace=None
 # keeps records at v5 or below, client_valuation='off' keeps
 # records at v6 or below, solo (non-sweep) runs keep records at v7
-# or below, population='static' keeps records at v8 or below, and
-# serial (single-device) GTG walks keep records at v9 or below —
+# or below, population='static' keeps records at v8 or below,
+# serial (single-device) GTG walks keep records at v9 or below, and
+# single-process runs keep records at v10 or below —
 # longitudinal tooling never sees a
 # layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 10
+METRICS_SCHEMA_VERSION = 11
+_GTG_SCHEMA_VERSION = 10
 _POPULATION_SCHEMA_VERSION = 9
 _SWEEP_SCHEMA_VERSION = 8
 _VALUATION_SCHEMA_VERSION = 7
@@ -117,7 +125,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
                        valuation: dict | None = None,
                        sweep: dict | None = None,
                        population: dict | None = None,
-                       gtg: dict | None = None) -> dict:
+                       gtg: dict | None = None,
+                       multihost: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -141,17 +150,23 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     population dict (robustness/population.PopulationModel.round_record)
     upgrades it to v9 under the ``"population"`` key; a gtg dict (the
     mesh-sharded GTG walk's provenance, algorithms/shapley.GTGShapley
-    .post_round) upgrades it to v10 under the ``"gtg"`` key.
+    .post_round) upgrades it to v10 under the ``"gtg"`` key; a
+    multihost dict (the distributed shard store's per-host assembly
+    summary, parallel/streaming.DistributedCohortStreamer
+    .multihost_record) upgrades it to v11 under the ``"multihost"``
+    key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
     ) and stream is None and costmodel is None and valuation is None and (
         sweep is None
-    ) and population is None and gtg is None:
+    ) and population is None and gtg is None and multihost is None:
         return base
     record = dict(base)
-    if gtg is not None:
+    if multihost is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif gtg is not None:
+        record["schema_version"] = _GTG_SCHEMA_VERSION
     elif population is not None:
         record["schema_version"] = _POPULATION_SCHEMA_VERSION
     elif sweep is not None:
@@ -186,6 +201,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["population"] = population
     if gtg is not None:
         record["gtg"] = gtg
+    if multihost is not None:
+        record["multihost"] = multihost
     return record
 
 

@@ -25,10 +25,27 @@ main()
     --worker_number 8 --round 3 --epoch 1 --learning_rate 0.1 \
     --multihost true --coordinator_address "127.0.0.1:$PORT" \
     --num_processes 2 --process_id "$1" \
-    --mesh_devices 2 --log_level INFO
+    --mesh_devices 2 --log_level INFO \
+    "${@:2}"
 }
 
 run 0 &
 PID0=$!
 run 1
+wait $PID0
+
+# The same topology with the DISTRIBUTED SHARD STORE (ISSUE 15): each
+# process owns half the clients and serves its members of every round's
+# owner-permuted cohort into its addressable mesh shards — streamed
+# million-client residency composed with multi-process scale. Requires
+# the hashed O(cohort) sampler (every host replays the full cohort per
+# round); metrics gain the schema-v11 multihost sub-object.
+PORT=$((PORT + 1))
+run 0 \
+  --client_residency streamed --participation_fraction 0.5 \
+  --participation_sampler hashed &
+PID0=$!
+run 1 \
+  --client_residency streamed --participation_fraction 0.5 \
+  --participation_sampler hashed
 wait $PID0

@@ -69,7 +69,17 @@ two devices must buy at least half a device's worth of extra walk
 throughput, never relatively tracked; bench arms the key only when the
 host could honestly measure it (>= 2 usable cores — a 1-core cgroup
 cannot overlap two devices' compute, and the unarmed measurement stays
-in the record under ``gtg.scaling``). The
+in the record under ``gtg.scaling``). The ``mhost`` leg's
+``mhost_cohort_rate`` (steady cohort-rounds/s of the 2-process
+distributed-shard-store N-sweep at its largest population,
+parallel/streaming.DistributedCohortStreamer) gets
+``--mhost-cohort-rate-threshold`` as an absolute floor, default 200 —
+the owner-sharded data plane (cohort assembly + spill exchange +
+per-host placement) must keep the composed streamed x multihost run
+off the host-bound floor, never relatively tracked; armed like the gtg
+gate only on hosts with >= 2 usable cores (a 1-core cgroup cannot
+overlap two processes' compute — the honest number stays unarmed
+under ``mhost.cohort_rate``). The
 ``costmodel`` leg's ``model_error_ratio`` per program (predicted /
 measured per-round ms from the roofline model, telemetry/costmodel.py)
 is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
@@ -379,6 +389,36 @@ def gtg_scaling_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def mhost_cohort_rate_gate(record: dict, threshold: float) -> dict | None:
+    """In-record multihost stream-throughput gate: bench.py's ``mhost``
+    leg runs the 2-process distributed-shard-store N-sweep (streamed +
+    hashed cohorts, owner-sharded assembly with the spill exchange —
+    parallel/streaming.DistributedCohortStreamer) and records
+    ``mhost_cohort_rate`` (cohort·rounds/s at the largest population) —
+    ONLY when the host had >= 2 usable cores, so the two processes'
+    compute genuinely overlaps (the PR 14 arming precedent: a 1-core
+    cgroup records the honest number under ``cohort_rate`` unarmed). A
+    rate below ``threshold`` means the distributed data plane stopped
+    keeping the composed run model-bound (exchange on the critical
+    path, lost prefetch overlap, per-round placement cost) — a
+    regression regardless of the old record. Judged ABSOLUTELY as an
+    in-record floor; None when the leg is absent (including unarmed) or
+    the floor holds."""
+    rate = get_path(record, "mhost.mhost_cohort_rate")
+    if rate is None or rate >= threshold:
+        return None
+    return {
+        "metric": "mhost.mhost_cohort_rate",
+        "description": (
+            "steady cohort-rounds/s of the 2-process distributed "
+            "shard store at the largest swept population (the "
+            "owner-sharded data plane must stay off the critical path)"
+        ),
+        "old": threshold, "new": rate,
+        "relative_change": None, "direction": "higher",
+    }
+
+
 def churn_overhead_gate(record: dict, threshold: float) -> dict | None:
     """In-record open-world-churn gate: bench.py's ``churn`` leg runs a
     10x population-growth ``population='dynamic'`` run against the same
@@ -508,6 +548,16 @@ def main(argv: list[str] | None = None) -> int:
                          "buy at least 1.5x; bench records the key only "
                          "on hosts that can honestly measure it, i.e. "
                          ">= 2 usable cores)")
+    ap.add_argument("--mhost-cohort-rate-threshold", type=float,
+                    default=200.0,
+                    help="min tolerated steady cohort-rounds/s in the NEW "
+                         "record's mhost leg at its largest population "
+                         "(default 200 — the 2-process distributed shard "
+                         "store must keep the composed streamed run off "
+                         "the host-bound floor; bench records the gated "
+                         "key only on hosts with >= 2 usable cores, "
+                         "where the two processes' compute genuinely "
+                         "overlaps)")
     ap.add_argument("--churn-overhead-threshold", type=float, default=0.10,
                     help="max tolerated dynamic-vs-static round-time "
                          "overhead ratio in the NEW record's churn leg "
@@ -551,6 +601,7 @@ def main(argv: list[str] | None = None) -> int:
         valuation_corr_gate(new, args.valuation_corr_threshold),
         gtg_scaling_gate(new, args.gtg_scaling_threshold),
         churn_overhead_gate(new, args.churn_overhead_threshold),
+        mhost_cohort_rate_gate(new, args.mhost_cohort_rate_threshold),
     ):
         if gate is not None:
             result["regressions"].append(gate)

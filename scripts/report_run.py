@@ -504,6 +504,11 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
                 sum(s.get("sample_ms", 0.0) for s in streams), 3
             )
 
+    # --- multihost sub-objects (schema v11, distributed shard store) --------
+    mh_summary = summarize_multihost(records)
+    if mh_summary is not None:
+        summary["multihost"] = mh_summary
+
     health = summarize_client_health(records)
     if health is not None:
         summary["client_health"] = health
@@ -567,6 +572,34 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     return summary
 
 
+def summarize_multihost(records: list[dict]) -> dict | None:
+    """schema-v11 ``multihost`` sub-objects: the distributed shard
+    store's per-host assembly provenance (parallel/streaming
+    .DistributedCohortStreamer). The shard-ownership fields are static
+    per run (last record wins); spill/DCN traffic accumulates over the
+    recorded rounds. None for single-process runs — the off-gate
+    rendering convention."""
+    mhs = [r["multihost"] for r in records
+           if isinstance(r.get("multihost"), dict)]
+    if not mhs:
+        return None
+    last = mhs[-1]
+    overlaps = [m["overlap_ratio"] for m in mhs
+                if m.get("overlap_ratio") is not None]
+    return {
+        "hosts": last["hosts"],
+        "host_id": last["host_id"],
+        "owned_clients": last["owned_clients"],
+        "shard_bytes": last["shard_bytes"],
+        "rounds_reported": len(mhs),
+        "spill_rows": sum(int(m.get("spill_rows", 0)) for m in mhs),
+        "dcn_bytes": sum(int(m.get("dcn_bytes", 0)) for m in mhs),
+        "mean_overlap_ratio": (
+            round(sum(overlaps) / len(overlaps), 4) if overlaps else 0.0
+        ),
+    }
+
+
 def render_summary(summary: dict) -> list[str]:
     """Terminal rendering of :func:`summarize_run`'s output."""
     lines = []
@@ -575,6 +608,18 @@ def render_summary(summary: dict) -> list[str]:
         f"run: rounds {summary['first_round']}..{summary['last_round']} "
         f"({summary['rounds']} recorded, metrics schema v{v})"
     )
+    if "multihost" in summary:
+        # The manifest line of the run header: which host's record
+        # stream this artifact dir holds, and its shard of the
+        # host-sharded population (per-host checkpoint shards carry the
+        # same split — utils/checkpoint.py manifests).
+        m = summary["multihost"]
+        lines.append(
+            f"manifest: {m['hosts']}-host distributed shard store — "
+            f"this record stream is host {m['host_id']}, owning "
+            f"{m['owned_clients']} clients "
+            f"({m['shard_bytes'] / 2**20:.1f} MiB shard)"
+        )
     accs = [a for a in summary["accuracy_curve"] if a is not None]
     if accs:
         lines.append(
@@ -634,6 +679,18 @@ def render_summary(summary: dict) -> list[str]:
                 f"({s['sample_ms']:.1f} ms total replay — the `sample` "
                 "phase row)"
             )
+    if "multihost" in summary:
+        # Per-host shard summary (schema v11): this host's share of the
+        # owner-sharded assembly — spill is the per-round ownership
+        # imbalance, the ONLY client data that crosses DCN.
+        m = summary["multihost"]
+        lines.append(
+            f"  distributed store: host {m['host_id']}/{m['hosts']} "
+            f"served {m['rounds_reported']} round(s); spill "
+            f"{m['spill_rows']} row(s), "
+            f"{m['dcn_bytes'] / 2**20:.2f} MiB over DCN, mean upload "
+            f"overlap {m['mean_overlap_ratio']:.1%}"
+        )
     if "compiles" in summary:
         c = summary["compiles"]
         lines.append(

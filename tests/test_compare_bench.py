@@ -592,3 +592,48 @@ def test_cli_exit_codes(cb, tmp_path):
     proc = run(paths["old"], paths["bad"], "--json")
     assert proc.returncode == 1
     assert json.loads(proc.stdout)["regressions"]
+
+
+def test_mhost_cohort_rate_not_relatively_tracked(cb):
+    """The 2-process distributed-store cohort rate is machine-bound —
+    like every other in-record gated value it must never be a relative
+    TRACKED metric; only the absolute floor judges it."""
+    old = _record(mhost={"mhost_cohort_rate": 9000.0})
+    new = _record(mhost={"mhost_cohort_rate": 5000.0})
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "mhost" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_mhost_cohort_rate_self_gate(cb, tmp_path):
+    """In-record absolute floor on the 2-process streamed sweep's
+    steady cohort rate; an unarmed record (1-core host — bench keeps
+    the honest number under mhost.cohort_rate but never sets the gated
+    key, the PR 14 arming precedent) skips."""
+    assert cb.mhost_cohort_rate_gate(_record(), 200.0) is None  # absent
+    unarmed = _record(mhost={"cohort_rate": 38.2, "host_cores": 1})
+    assert cb.mhost_cohort_rate_gate(unarmed, 200.0) is None
+    ok = _record(mhost={"mhost_cohort_rate": 512.0, "cohort_rate": 512.0})
+    assert cb.mhost_cohort_rate_gate(ok, 200.0) is None
+    bad = _record(mhost={"mhost_cohort_rate": 61.0, "cohort_rate": 61.0})
+    entry = cb.mhost_cohort_rate_gate(bad, 200.0)
+    assert entry and entry["new"] == 61.0 and entry["direction"] == "higher"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "mhost.mhost_cohort_rate" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--mhost-cohort-rate-threshold", "50"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0

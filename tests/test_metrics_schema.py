@@ -5,12 +5,13 @@ tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle, sweep engine) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
 (+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel), v7
-(+valuation), v8 (+sweep), v9 (+population) and v10 (+gtg) records
-must validate;
+(+valuation), v8 (+sweep), v9 (+population), v10 (+gtg) and v11
+(+multihost) records must validate;
 records that mix versions and sub-objects inconsistently must not. The
 integration tests in test_client_stats.py (test_costmodel.py for v6,
 test_valuation.py for v7, test_sweep.py for v8, test_population.py for
-v9, test_gtg_mesh.py for v10) validate REAL produced records against
+v9, test_gtg_mesh.py for v10, test_multihost.py's 2-process harness
+for v11) validate REAL produced records against
 the same file.
 """
 
@@ -22,6 +23,7 @@ import pytest
 
 from distributed_learning_simulator_tpu.utils.reporting import (
     METRICS_SCHEMA_VERSION,
+    _GTG_SCHEMA_VERSION,
     build_round_record,
 )
 
@@ -364,7 +366,7 @@ def test_v10_record_validates():
         _base(), _telemetry(), _client_stats(), _async(), _stream(),
         _costmodel(), _valuation(), _sweep(), _population(), _gtg(),
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 10
+    assert record["schema_version"] == _GTG_SCHEMA_VERSION == 10
     validate(record)
     # gtg alone (every other feature off) is still v10 — a mesh-sharded
     # GTG run at default telemetry. (keep_client_params always leaves
@@ -392,6 +394,39 @@ def test_v10_record_validates():
     validate(record)
 
 
+def _multihost() -> dict:
+    return {
+        "hosts": 2,
+        "host_id": 0,
+        "owned_clients": 500000,
+        "shard_bytes": 551182336,
+        "spill_rows": 9,
+        "dcn_bytes": 41544,
+        "h2d_seconds": 0.0041,
+        "overlap_ratio": 0.83,
+    }
+
+
+def test_v11_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), None, None, _stream(),
+        multihost=_multihost(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 11
+    validate(record)
+    # multihost alone (default telemetry) is still v11 — a distributed
+    # streamed run with everything else off.
+    validate(build_round_record(
+        {**_base(), "cohort_hash": 7, "mean_client_loss": 1.2},
+        multihost=_multihost(),
+    ))
+    # The full-cohort regime reports structurally-zero spill.
+    validate(build_round_record(
+        _base(),
+        multihost={**_multihost(), "spill_rows": 0, "dcn_bytes": 0},
+    ))
+
+
 def test_lowest_version_stamping_preserved():
     """Adding v10 must not disturb the lower stamps: the version is the
     LOWEST that describes the record (longitudinal byte-identity)."""
@@ -412,6 +447,8 @@ def test_lowest_version_stamping_preserved():
         "schema_version"] == 8
     assert build_round_record(_base(), population=_population())[
         "schema_version"] == 9
+    assert build_round_record(_base(), gtg=_gtg())[
+        "schema_version"] == 10
 
 
 def test_version_content_mismatches_rejected():
@@ -576,6 +613,26 @@ def test_version_content_mismatches_rejected():
     # schema breaks, not silent extensions.
     for poison in ({"mystery": 1}, {"devices": 1}):
         bad = build_round_record(_base(), gtg={**_gtg(), **poison})
+        with pytest.raises(jsonschema.ValidationError):
+            validate(bad)
+    # v10 stamp smuggling a multihost sub-object (the builder always
+    # stamps multihost records v11).
+    bad = build_round_record(_base(), gtg=_gtg())
+    bad["multihost"] = _multihost()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v11 stamp without the multihost sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 11
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown multihost keys — and a single-process run claiming the
+    # sub-object (hosts < 2: 1-process streamed runs must keep pre-v11
+    # records) — are schema breaks, not silent extensions.
+    for poison in ({"mystery": 1}, {"hosts": 1}):
+        bad = build_round_record(
+            _base(), multihost={**_multihost(), **poison}
+        )
         with pytest.raises(jsonschema.ValidationError):
             validate(bad)
 

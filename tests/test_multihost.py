@@ -66,6 +66,55 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _launch_two(code: str, extra_args=None, timeout: int = 300,
+                env_overrides=None):
+    """THE 2-process launch helper (the three hand-copied Popen blocks
+    this file used to carry): run ``code`` in two fresh interpreters
+    against a fresh coordinator port. ``extra_args(i)`` (or a plain
+    list shared by both) supplies per-process argv after the standard
+    ``addr process_id`` pair; ``env_overrides[i]`` merges per-process
+    env (the chaos tests SIGKILL one host only). Returns
+    ``[(returncode, stdout, stderr), ...]`` — callers assert rc
+    themselves because the chaos variants EXPECT nonzero exits; a
+    process that outlives ``timeout`` (a host blocked on a collective
+    whose peer died) is killed and reported with its partial output.
+    """
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    procs = []
+    for i in range(2):
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        if env_overrides and env_overrides[i]:
+            env.update(env_overrides[i])
+        args = (
+            extra_args(i) if callable(extra_args)
+            else list(extra_args or [])
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code, addr, str(i), *args],
+            cwd=repo, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((p.returncode, out, err))
+    return outs
+
+
+def _assert_ok(outs):
+    """Assert both processes exited cleanly; return their stdouts."""
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (i, out, err)
+    return [out for _, out, _ in outs]
+
+
 _WORKER_CODE = textwrap.dedent("""
     import sys
     import jax
@@ -130,23 +179,8 @@ def _run_two_process_train(extra: dict | None = None) -> list[str]:
     (both asserted rc=0)."""
     import json
 
-    port = _free_port()
-    addr = f"127.0.0.1:{port}"
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    repo = os.path.join(os.path.dirname(__file__), "..")
     args = [json.dumps(extra)] if extra else []
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _TRAIN_CODE, addr, str(i), *args],
-            cwd=repo, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = [p.communicate(timeout=300) for p in procs]
-    for i, (p, (out, err)) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, (i, out, err)
-    return [out for out, _ in outs]
+    return _assert_ok(_launch_two(_TRAIN_CODE, args))
 
 
 def _final_accs(outs: list[str]) -> list[str]:
@@ -283,25 +317,9 @@ def _write_seed_checkpoint(ckpt_dir: str) -> None:
 
 
 def _run_two_process_resume(dirs: list[str], expect: str) -> list[str]:
-    port = _free_port()
-    addr = f"127.0.0.1:{port}"
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    repo = os.path.join(os.path.dirname(__file__), "..")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _RESUME_CODE, addr, str(i), dirs[i],
-             expect],
-            cwd=repo, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = [p.communicate(timeout=300) for p in procs]
-    lines = []
-    for i, (p, (out, err)) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, (i, out, err)
-        lines.append(out)
-    return lines
+    return _assert_ok(
+        _launch_two(_RESUME_CODE, lambda i: [dirs[i], expect])
+    )
 
 
 def test_two_process_resume_shared_dir_ok(tmp_path):
@@ -331,20 +349,251 @@ def test_two_process_cpu_distributed_smoke():
     """Real 2-process jax.distributed bring-up over localhost: the actual
     DCN code path (coordinator service + global device enumeration), on the
     CPU backend."""
-    port = _free_port()
-    addr = f"127.0.0.1:{port}"
-    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.join(os.path.dirname(__file__), "..")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _WORKER_CODE, addr, str(i)],
-            cwd=repo, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
+    outs = _assert_ok(_launch_two(_WORKER_CODE, timeout=240))
+    for i, out in enumerate(outs):
+        assert f"MULTIHOST_OK {i}" in out, (i, out)
+
+
+# --- distributed shard store (streamed x multihost; ISSUE 15) ---------------
+
+_STREAM_CODE = textwrap.dedent("""
+    import json
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.simulator import run_simulation
+
+    extra = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
+    config = ExperimentConfig(
+        dataset_name="synthetic", model_name="mlp",
+        distributed_algorithm=extra.pop("distributed_algorithm", "fed"),
+        worker_number=8, round=extra.pop("round", 3), epoch=1,
+        learning_rate=extra.pop("learning_rate", 0.1),
+        n_train=256, n_test=128, log_level="ERROR",
+        multihost=True, coordinator_address=sys.argv[1], num_processes=2,
+        process_id=int(sys.argv[2]), mesh_devices=2,
+        client_residency="streamed", **extra,
+    )
+    try:
+        res = run_simulation(config, setup_logging=False)
+    except RuntimeError as e:
+        # The topology-mismatch variant expects a cause-named refusal.
+        print("REFUSED", sys.argv[2], str(e)[:200].replace("\\n", " "))
+        sys.exit(0)
+    keep = [
+        {k: h[k]
+         for k in ("round", "test_accuracy", "test_loss",
+                   "mean_client_loss", "cohort_hash")
+         if k in h}
+        for h in res["history"]
     ]
-    outs = [p.communicate(timeout=240) for p in procs]
-    for i, (p, (out, err)) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, (i, out, err)
-        assert f"MULTIHOST_OK {i}" in out, (i, out, err)
+    print("HIST", sys.argv[2], json.dumps(keep))
+    print("MHSUM", sys.argv[2], json.dumps(res["multihost_summary"]))
+""")
+
+_STATEFUL = {
+    # Persistent per-client optimizer state: the composition that
+    # exercises BOTH exchange directions (state spill-in at gather,
+    # owner return at writeback) and gives the checkpoint shards real
+    # per-host content.
+    "momentum": 0.9, "reset_client_optimizer": False,
+    "participation_fraction": 0.5, "participation_sampler": "hashed",
+}
+
+
+def _stream_two(extra: dict, env_overrides=None, expect_rc=True):
+    import json
+
+    outs = _launch_two(_STREAM_CODE, [json.dumps(extra)],
+                       env_overrides=env_overrides, timeout=420)
+    if expect_rc:
+        return _assert_ok(outs)
+    return outs
+
+
+def _hist_of(out: str) -> list[dict]:
+    import json
+
+    lines = [ln for ln in out.splitlines() if ln.startswith("HIST")]
+    assert lines, out
+    return json.loads(lines[0].split(" ", 2)[2])
+
+
+def _solo_streamed_history(extra: dict) -> list[dict]:
+    """The 1-process reference at the SAME fixed global mesh (2 devices
+    from the conftest's virtual-CPU pool), run in-process."""
+    from distributed_learning_simulator_tpu.config import (
+        ExperimentConfig as _EC,
+    )
+
+    extra = dict(extra)
+    cfg = _EC(
+        dataset_name="synthetic", model_name="mlp",
+        distributed_algorithm=extra.pop("distributed_algorithm", "fed"),
+        worker_number=8, round=extra.pop("round", 3), epoch=1,
+        learning_rate=extra.pop("learning_rate", 0.1),
+        n_train=256, n_test=128, log_level="ERROR", mesh_devices=2,
+        **extra,
+    )
+    return run_simulation(cfg, setup_logging=False)["history"]
+
+
+def _assert_histories_close(mh_hist, ref_hist, bit_exact=False):
+    """The PR 7 contract at the distributed layout: identical cohort
+    sequence (cohort_hash bitwise) and identical metrics — bit-exact
+    where promised (sign_SGD), else to the documented resident-vs-mesh
+    reduction-order tolerance (the owner permutation only moves the
+    aggregation's summation order)."""
+    assert len(mh_hist) == len(ref_hist)
+    for a, b in zip(mh_hist, ref_hist):
+        assert a["round"] == b["round"]
+        if "cohort_hash" in b:
+            assert a["cohort_hash"] == b["cohort_hash"], (a, b)
+        for k in ("test_accuracy", "test_loss", "mean_client_loss"):
+            if bit_exact:
+                assert a[k] == b[k], (k, a, b)
+            else:
+                assert abs(a[k] - b[k]) <= 1e-4 * max(abs(b[k]), 1.0), (
+                    k, a, b,
+                )
+
+
+def test_two_process_distributed_store_matches_single_process():
+    """THE composition ISSUE 15 exists for: streamed residency across 2
+    host processes — each owning half the clients, serving its members
+    of the owner-permuted cohort into its addressable shards, with
+    persistent per-client state riding the spill exchange — produces
+    the SAME run as the 1-process streamed program and the resident
+    program at the same fixed global mesh."""
+    import json
+
+    outs = _stream_two(dict(_STATEFUL))
+    h0, h1 = _hist_of(outs[0]), _hist_of(outs[1])
+    assert h0 == h1  # SPMD: both processes see the same run
+    # Per-host shard summary: complementary halves of the population.
+    sums = []
+    for out in outs:
+        ln = [ln for ln in out.splitlines() if ln.startswith("MHSUM")][0]
+        sums.append(json.loads(ln.split(" ", 2)[2]))
+    assert {s["host_id"] for s in sums} == {0, 1}
+    assert all(s["hosts"] == 2 for s in sums)
+    assert sum(s["owned_clients"] for s in sums) == 8
+    ref_streamed = _solo_streamed_history(
+        dict(_STATEFUL, client_residency="streamed")
+    )
+    ref_resident = _solo_streamed_history(
+        dict(_STATEFUL, client_residency="resident")
+    )
+    _assert_histories_close(h0, ref_streamed)
+    _assert_histories_close(h0, ref_resident)
+
+
+def test_two_process_distributed_store_sign_sgd_bit_exact():
+    """Full-cohort regime (sign_SGD trains everyone): owner bounds ARE
+    the device blocks, the permutation is the identity, zero bytes
+    cross DCN — and the 2-process run must match the 1-process streamed
+    run BIT-exactly."""
+    import json
+
+    extra = {"distributed_algorithm": "sign_SGD", "learning_rate": 0.01}
+    outs = _stream_two(dict(extra))
+    h0 = _hist_of(outs[0])
+    assert h0 == _hist_of(outs[1])
+    for out in outs:
+        ln = [ln for ln in out.splitlines() if ln.startswith("MHSUM")][0]
+        s = json.loads(ln.split(" ", 2)[2])
+        assert s["spill_rows"] == 0 and s["dcn_bytes"] == 0, s
+    ref = _solo_streamed_history(
+        dict(extra, client_residency="streamed")
+    )
+    _assert_histories_close(h0, ref, bit_exact=True)
+
+
+def test_two_process_sharded_checkpoint_sigkill_resume(tmp_path):
+    """Per-host checkpoint shards + manifest survive a SIGKILL of one
+    host mid-run: the resumed 2-process run stitches BIT-identically to
+    the uninterrupted 2-process run (the PR 2 chaos contract at shard
+    granularity)."""
+    ckpt = str(tmp_path / "shards")
+    base = dict(_STATEFUL, round=4, checkpoint_dir=ckpt,
+                checkpoint_every=1)
+    # Uninterrupted reference (its checkpoint dir is separate).
+    ref_dir = str(tmp_path / "ref_shards")
+    ref_hist = _hist_of(_stream_two(
+        dict(base, checkpoint_dir=ref_dir)
+    )[0])
+    # Crash: host 1 SIGKILLs itself right after round 1's shard landed
+    # (robustness/chaos.py fires after the checkpoint block); host 0
+    # then dies on the broken collective — both exits are expected.
+    outs = _stream_two(
+        dict(base),
+        env_overrides=(None, {"DLS_CRASH_AT_ROUND": "1",
+                              "DLS_CRASH_KIND": "sigkill"}),
+        expect_rc=False,
+    )
+    assert any(rc != 0 for rc, _, _ in outs), outs
+    manifests = sorted(
+        f for f in os.listdir(ckpt) if f.endswith("manifest.json")
+    )
+    assert manifests, os.listdir(ckpt)
+    # Resume: restores the newest committed round on BOTH hosts and
+    # finishes the run; stitched rounds equal the reference bit-for-bit.
+    outs = _stream_two(dict(base, resume=True))
+    resumed = _hist_of(outs[0])
+    assert resumed == _hist_of(outs[1])
+    start = resumed[0]["round"]
+    assert 0 < start < 4  # genuinely resumed mid-run
+    assert resumed == ref_hist[start:], (resumed, ref_hist)
+
+
+def test_two_process_resume_topology_mismatch_refused(tmp_path):
+    """A manifest cut for a different host topology refuses resume with
+    the cause named, on BOTH processes, instead of restoring shards
+    into the wrong owners."""
+    import json
+
+    ckpt = str(tmp_path / "shards")
+    base = dict(_STATEFUL, round=2, checkpoint_dir=ckpt,
+                checkpoint_every=1)
+    _stream_two(dict(base))
+    # Rewrite the newest manifest as if written by a 3-host run.
+    manifests = sorted(
+        f for f in os.listdir(ckpt) if f.endswith("manifest.json")
+    )
+    path = os.path.join(ckpt, manifests[-1])
+    m = json.load(open(path))
+    m["n_hosts"] = 3
+    json.dump(m, open(path, "w"))
+    outs = _stream_two(dict(base, resume=True))
+    for i, out in enumerate(outs):
+        lines = [ln for ln in out.splitlines() if ln.startswith("REFUSED")]
+        assert lines, (i, out)
+        assert "topology mismatch" in lines[0], lines[0]
+
+
+def test_single_process_resume_of_sharded_dir_refused(tmp_path):
+    """A single-process run pointed at a sharded checkpoint dir refuses
+    with the cause named instead of silently starting from scratch.
+    In-process (no subprocesses): the refusal fires at discovery."""
+    import pytest
+
+    from distributed_learning_simulator_tpu.config import ExperimentConfig
+    from distributed_learning_simulator_tpu.utils.checkpoint import (
+        write_manifest,
+    )
+
+    ckpt = str(tmp_path / "shards")
+    os.makedirs(ckpt)
+    write_manifest(ckpt, 0, {"n_hosts": 2, "n_clients": 8,
+                             "owner_bounds": [0, 4, 8]})
+    cfg = ExperimentConfig(
+        dataset_name="synthetic", model_name="mlp",
+        distributed_algorithm="fed", worker_number=8, round=1, epoch=1,
+        learning_rate=0.1, n_train=256, n_test=128, log_level="ERROR",
+        client_residency="streamed", participation_fraction=0.5,
+        participation_sampler="hashed",
+        checkpoint_dir=ckpt, checkpoint_every=1, resume=True,
+    )
+    with pytest.raises(RuntimeError, match="sharded checkpoints"):
+        run_simulation(cfg, setup_logging=False)
