@@ -46,7 +46,17 @@ sub-object re-runs the headline program with ``client_stats='on'``
 — scripts/compare_bench.py gates it (--stats-overhead-threshold);
 BENCH_CLIENT_STATS=0 skips, BENCH_CLIENT_STATS_ROUNDS sets its length.
 The client-stats knobs land in ``config_hash`` like every other
-program-defining field. The ``round_batch`` sub-object sweeps
+program-defining field. The ``spans`` sub-object follows the same
+shape for the distributed tracer (telemetry/spans.py): the headline
+program re-run with ``span_trace='on'`` and its on-vs-off
+``overhead_ratio`` — gated absolutely by compare_bench.py
+(--span-overhead-threshold, default 0.05); BENCH_SPANS=0 skips,
+BENCH_SPANS_ROUNDS sets its length. The ``mhost`` leg additionally
+runs ONE spans-on 2-process pair at its largest population (the timed
+sweep stays span-off) and records ``barrier_skew_ms`` — the worst
+spill-exchange arrival skew either host saw — plus per-host DCN
+wait/transfer splits; BENCH_MHOST_SPANS=0 skips.
+The ``round_batch`` sub-object sweeps
 ``rounds_per_dispatch`` K in {1, BENCH_ROUND_BATCH_K} on the headline
 program and records the wall-based K-vs-1 ``amortization_ratio``
 (docs/PERFORMANCE.md § Round batching) — compare_bench.py gates it
@@ -459,6 +469,10 @@ addr, pid, n, cohort, shard, rounds = (
     sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
     int(sys.argv[5]), int(sys.argv[6]),
 )
+span_dir = sys.argv[7] if len(sys.argv) > 7 else "-"
+span_knobs = (
+    {"span_trace": "on", "span_dir": span_dir} if span_dir != "-" else {}
+)
 ds = get_dataset("synthetic", n_train=4096, n_test=512, seed=0)
 lo, hi = float(ds.x_train.min()), float(ds.x_train.max())
 scale = lambda x: (x - lo) / (hi - lo)
@@ -473,7 +487,7 @@ config = ExperimentConfig(
     participation_fraction=cohort / n, participation_sampler="hashed",
     client_residency="streamed", log_level="ERROR",
     multihost=True, coordinator_address=addr, num_processes=2,
-    process_id=pid, mesh_devices=2,
+    process_id=pid, mesh_devices=2, **span_knobs,
 )
 res = run_simulation(config, dataset=ds, client_data=client_data)
 steady = [h["round_seconds"] for h in res["history"][1:]]
@@ -483,8 +497,52 @@ print("MHOST_JSON", json.dumps({
     "overlap_ratio": round(res["stream_overlap_ratio"], 4),
     "dcn_bytes": res["stream_dcn_bytes"],
     "summary": res["multihost_summary"],
+    "span_summary": res["span_summary"],
 }))
 """
+
+
+def _mhost_pair(n: int, cohort: int, shard: int, rounds: int,
+                span_dir: str | None = None):
+    """Launch one 2-process localhost pair; returns (per-host MHOST_JSON
+    dicts, error string or None). ``span_dir`` turns on span_trace in
+    both children with a shared journal directory."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        addr = f"127.0.0.1:{s.getsockname()[1]}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _MHOST_CHILD, addr, str(i),
+             str(n), str(cohort), str(shard), str(rounds),
+             span_dir or "-"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=1800) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return None, "timeout"
+    per_host = []
+    for i, (p, (o, e)) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            return None, f"proc {i}: {(e or o).strip()[-400:]}"
+        line = [ln for ln in o.splitlines()
+                if ln.startswith("MHOST_JSON")]
+        if not line:
+            return None, f"proc {i}: no MHOST_JSON line"
+        per_host.append(json.loads(line[0].split(" ", 1)[1]))
+    return per_host, None
 
 
 def _mhost_leg() -> dict:
@@ -511,10 +569,6 @@ def _mhost_leg() -> dict:
     leg peaks at ~1.5x the single-process stream leg's host RAM per
     process.
     """
-    import socket
-    import subprocess
-    import sys
-
     sweep = sorted(
         int(s) for s in os.environ.get(
             "BENCH_MHOST_SWEEP", "10000,100000,1000000"
@@ -532,42 +586,11 @@ def _mhost_leg() -> dict:
     out = {"processes": 2, "cohort": cohort, "shard_size": shard,
            "rounds": rounds, "host_cores": cores, "sweep": []}
     for n in sweep:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            addr = f"127.0.0.1:{s.getsockname()[1]}"
-        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-        env["JAX_PLATFORMS"] = "cpu"
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-c", _MHOST_CHILD, addr, str(i),
-                 str(n), str(cohort), str(shard), str(rounds)],
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE, text=True,
-            )
-            for i in range(2)
-        ]
         entry = {"n_clients": n}
-        try:
-            outs = [p.communicate(timeout=1800) for p in procs]
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            entry["error"] = "timeout"
-            out["sweep"].append(entry)
-            continue
-        per_host = []
-        for i, (p, (o, e)) in enumerate(zip(procs, outs)):
-            if p.returncode != 0:
-                entry["error"] = f"proc {i}: {(e or o).strip()[-400:]}"
-                break
-            line = [ln for ln in o.splitlines()
-                    if ln.startswith("MHOST_JSON")]
-            if not line:
-                entry["error"] = f"proc {i}: no MHOST_JSON line"
-                break
-            per_host.append(json.loads(line[0].split(" ", 1)[1]))
-        if "error" not in entry:
+        per_host, err = _mhost_pair(n, cohort, shard, rounds)
+        if err is not None:
+            entry["error"] = err
+        else:
             entry.update({
                 k: per_host[0][k]
                 for k in ("round_ms", "cohort_rate", "dcn_bytes")
@@ -591,6 +614,38 @@ def _mhost_leg() -> dict:
         # is armed only when the two processes' compute can genuinely
         # overlap — the PR 14 honest-number-unarmed precedent.
         out["mhost_cohort_rate"] = gate_entry["cohort_rate"]
+    # Barrier-skew attribution run (ISSUE 19, telemetry/spans.py): one
+    # EXTRA 2-process run at the largest population with span_trace='on'
+    # and a shared journal dir. The timed sweep above stays span-OFF —
+    # its rates keep measuring the exact pre-feature program (off-gate);
+    # this run's numbers are attribution only, never rate-gated.
+    if os.environ.get("BENCH_MHOST_SPANS", "1") != "0":
+        import shutil
+        import tempfile
+
+        sp_dir = tempfile.mkdtemp(prefix="bench_mhost_spans_")
+        per_host, err = _mhost_pair(out["max_n"], cohort, shard, rounds,
+                                    span_dir=sp_dir)
+        if err is not None:
+            out["spans_error"] = err
+        else:
+            sums = [h.get("span_summary") or {} for h in per_host]
+            skews = [s.get("spill_skew_ms_max") for s in sums
+                     if s.get("spill_skew_ms_max") is not None]
+            # The worst spill-exchange arrival skew either host saw over
+            # the run — the cross-host imbalance number (max-min host
+            # arrival at the allgather, docs/OBSERVABILITY.md).
+            out["barrier_skew_ms"] = (
+                round(max(skews), 3) if skews else None
+            )
+            out["span_hosts"] = [
+                {"host_id": s.get("host_id"),
+                 "spans": s.get("count"),
+                 "dcn_wait_s": s.get("dcn_wait_s"),
+                 "dcn_transfer_s": s.get("dcn_transfer_s")}
+                for s in sums
+            ]
+        shutil.rmtree(sp_dir, ignore_errors=True)
     return out
 
 
@@ -911,6 +966,47 @@ def main():
             ),
             "clients_flagged": cs_result["clients_flagged"],
         }
+
+    # Span-trace overhead (ISSUE 19, telemetry/spans.py): the SAME
+    # headline program with span_trace='on', so overhead_ratio is an
+    # apples-to-apples on-vs-off round-time ratio measured in one bench
+    # run on one machine — the number compare_bench.py's
+    # --span-overhead-threshold gates as an ABSOLUTE ceiling (default
+    # 0.05: the recorder's promise is "cheap enough to leave on in
+    # production"; a near-zero ratio must never be tracked relatively —
+    # the PR 4/5 precedent). BENCH_SPANS=0 skips.
+    run_spans = (
+        os.environ.get("BENCH_SPANS", "1") != "0"
+        and model == "cnn_tpu"
+        and n_clients == 1000
+    )
+    if run_spans:
+        import shutil
+        import tempfile
+
+        sp_rounds = int(os.environ.get("BENCH_SPANS_ROUNDS", "5"))
+        sp_dir = tempfile.mkdtemp(prefix="bench_spans_")
+        sp_config = ExperimentConfig(
+            model_name=model, round=sp_rounds + 1, client_chunk_size=chunk,
+            local_compute_dtype=dtype, span_trace="on", span_dir=sp_dir,
+            **failure_knobs, **common,
+        )
+        sp_times, sp_result = _run(
+            sp_config, dataset=dataset, client_data=client_data
+        )
+        sr = _rates(sp_times, n_clients)
+        ssum = sp_result["span_summary"] or {}
+        record["spans"] = {
+            "value": round(sr["median_rate"], 2),
+            "rounds": sp_rounds,
+            "round_ms": {k: round(v, 1) for k, v in sr["round_ms"].items()},
+            "overhead_ratio": round(
+                sr["round_ms"]["median"] / r["round_ms"]["median"] - 1.0, 4
+            ),
+            "span_count": ssum.get("count"),
+            "dropped": ssum.get("dropped"),
+        }
+        shutil.rmtree(sp_dir, ignore_errors=True)
 
     # Round batching (ISSUE 5, config.rounds_per_dispatch): the SAME
     # headline program dispatched K rounds at a time, so the

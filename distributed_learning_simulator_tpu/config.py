@@ -451,6 +451,29 @@ class ExperimentConfig:
     # time; fencing defeats round pipelining's transfer/compute overlap —
     # a measurement mode, not a production mode.
     telemetry_level: str = "off"
+    # --- distributed tracing (telemetry/spans.py) ---------------------------
+    # "off" (default): zero instrumentation — the exact pre-feature
+    # program (byte-identical records, 0 post-warmup compiles,
+    # config_hash unchanged). "on": a per-host structured span recorder
+    # wraps every phase boundary plus the multihost seams (DCN spill
+    # exchange wait-vs-transfer, prefetch worker occupancy, checkpoint
+    # shard write + manifest barrier wait, recompile events) and journals
+    # them to spans_<host_id>.jsonl in the artifacts dir; the buffer
+    # doubles as a crash flight recorder (docs/OBSERVABILITY.md
+    # § Distributed tracing). Works at any telemetry_level.
+    span_trace: str = "off"
+    # Journal directory override. None (default): the run's artifacts
+    # dir — which only the PRIMARY host has (non-primary hosts skip
+    # set_run_artifacts), so multihost runs that want every host's
+    # journal pass a shared directory here. Pure I/O routing, never part
+    # of the compiled program (config_hash exempt).
+    span_dir: str | None = None
+    # Bounded in-memory span ring: overflow increments the record's
+    # `dropped` counter instead of blocking the hot path.
+    span_buffer_size: int = 4096
+    # How many completed spans the flight recorder force-flushes (plus
+    # every still-open span) on SIGTERM / quorum rejection / crash.
+    span_flush_last_k: int = 64
     # --- per-client statistics (telemetry/client_stats.py) ------------------
     # "off" (default): zero instrumentation — the round program is the
     # exact pre-feature program (same RNG streams, same HLO) and
@@ -1088,6 +1111,14 @@ class ExperimentConfig:
                 f"unknown telemetry_level {self.telemetry_level!r}; known: "
                 + ", ".join(TELEMETRY_LEVELS)
             )
+        if self.span_trace.lower() not in ("off", "on"):
+            raise ValueError(
+                f"unknown span_trace {self.span_trace!r}; known: off, on"
+            )
+        if self.span_buffer_size < 1:
+            raise ValueError("span_buffer_size must be >= 1")
+        if self.span_flush_last_k < 1:
+            raise ValueError("span_flush_last_k must be >= 1")
         if self.client_stats.lower() not in CLIENT_STATS_LEVELS:
             raise ValueError(
                 f"unknown client_stats {self.client_stats!r}; known: "
@@ -1296,7 +1327,7 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
                         "profile_dir", "cost_model_trace",
                         "client_chunk_size", "max_shard_size",
                         "coordinator_address", "sweep_seeds",
-                        "sweep_points", "sweep_dir"):
+                        "sweep_points", "sweep_dir", "span_dir"):
             typ = {
                 "round_trunc_threshold": float,
                 "client_chunk_size": int,

@@ -156,6 +156,72 @@ def initialize_multihost(
     return n
 
 
+def allgather_wall_stamps(stamp: float) -> np.ndarray:
+    """Gather one wall-clock stamp per host at full precision.
+
+    The naive float gather is silently useless: with x64 disabled (the
+    default) every float64 array crossing a collective is cast to
+    float32, whose resolution at a ~1.8e9 s Unix epoch is 128 s —
+    every host's stamp rounds to the SAME value and measured skews
+    read exactly 0.0. Split each stamp into its float32 head plus the
+    float64 remainder (|remainder| <= half the head's 128 s ulp, where
+    float32 resolution is ~4 µs) and rebuild float64 after the gather:
+    microsecond precision through a float32 pipe, well under the
+    collective-latency uncertainty floor.
+
+    Returns the ``[n_hosts]`` float64 stamp vector in process order.
+    Collective — main thread only.
+    """
+    from jax.experimental import multihost_utils
+
+    head = np.float32(stamp)
+    rest = np.float32(stamp - np.float64(head))
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.asarray([head, rest], np.float32)
+    )).reshape(-1, 2)
+    return (gathered[:, 0].astype(np.float64)
+            + gathered[:, 1].astype(np.float64))
+
+
+def estimate_clock_alignment() -> tuple[float, float]:
+    """Estimate this host's wall-clock offset vs host 0, for the span
+    journals (telemetry/spans.py headers).
+
+    Runs once, right after :func:`initialize_multihost` — the
+    barrier-synchronized moment when every host is provably inside the
+    same code region. Two back-to-back ``process_allgather`` barriers:
+    each host stamps ``clock.wall()`` immediately after the FIRST
+    barrier releases (all hosts release within one collective latency
+    of each other), and the SECOND gather publishes the stamps. The
+    offset is ``my_stamp - host0_stamp`` (positive = this host's wall
+    clock reads ahead of host 0's); the uncertainty is the measured
+    barrier release width — the round-trip this host observed across
+    the two collectives, an upper bound on how non-simultaneous the
+    stamps were. Good to ~collective-latency (µs on ICI, ms on DCN),
+    which is exactly the resolution the cross-host timeline needs:
+    barrier skews below the collective latency are not attributable
+    to hosts anyway.
+
+    Single-process (or uninitialized) runs return ``(0.0, 0.0)``.
+    """
+    if jax.process_count() <= 1:
+        return 0.0, 0.0
+    from jax.experimental import multihost_utils
+
+    from distributed_learning_simulator_tpu.telemetry import clock
+
+    # Barrier 1: align all hosts to within one collective latency.
+    multihost_utils.process_allgather(np.zeros([1], dtype=np.int32))
+    t_release = clock.monotonic()
+    stamp = clock.wall()
+    # Barrier 2: publish the post-release stamps (split-float gather —
+    # a plain float gather collapses to float32 and reads all-equal).
+    stamps = allgather_wall_stamps(stamp)
+    rtt = clock.monotonic() - t_release
+    offset = float(stamp - stamps[0])
+    return offset, float(rtt)
+
+
 def mesh_devices_per_host(mesh) -> list[int]:
     """Per-process device counts of a 1-D mesh, validated for the
     distributed shard store's contiguous-block layout.

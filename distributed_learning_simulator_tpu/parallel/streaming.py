@@ -47,6 +47,8 @@ host store.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -58,6 +60,31 @@ from distributed_learning_simulator_tpu.data.residency import (
     plan_owner_assembly,
     tree_bytes,
 )
+from distributed_learning_simulator_tpu.telemetry import clock
+
+# Straggler injection for the distributed-tracing tests (chaos-harness
+# precedent, robustness/chaos.py): when set, this host sleeps that many
+# seconds before each spill-exchange barrier — the OTHER hosts' measured
+# allgather wait then attributes the stall to this host. Inert unless
+# the environment variable is set; never set it in production.
+ENV_STRAGGLE = "DLS_STRAGGLE_S"
+
+
+def _maybe_straggle() -> None:
+    s = os.environ.get(ENV_STRAGGLE)
+    if s:
+        time.sleep(float(s))
+
+
+@contextlib.contextmanager
+def _maybe_span(rec, name: str, cat: str, **kw):
+    """Span context when a recorder is attached, no-op otherwise —
+    keeps the off-gate path free of even a null context object chain."""
+    if rec is None:
+        yield None
+    else:
+        with rec.span(name, cat, **kw) as extra:
+            yield extra
 
 
 def _nbytes(arrays) -> int:
@@ -122,6 +149,13 @@ class CohortStreamer:
             max_workers=1, thread_name_prefix="cohort-upload"
         )
         self._pending = None  # (idx_list, future) of the prefetched upload
+        # Distributed tracing (telemetry/spans.py): the simulator
+        # attaches a recorder when span_trace='on' (plus this host's
+        # clock offset vs host 0 and the current round index for skew
+        # attribution); None keeps every path below span-free.
+        self.span_recorder = None
+        self.clock_offset_s = 0.0
+        self.span_round: int | None = None
         # Run totals (the result dict's stream_* fields).
         self.totals = {
             "h2d_bytes": 0, "h2d_seconds": 0.0, "hidden_seconds": 0.0,
@@ -159,14 +193,14 @@ class CohortStreamer:
         registered index space with departed indices masked out, at the
         pinned startup cohort size — defaults keep the static replay
         byte-for-byte."""
-        t0 = time.perf_counter()
+        t0 = clock.monotonic()
         if self._cpu is not None:
             round_key = jax.device_put(round_key, self._cpu)
         idx = self._algorithm.cohort_indices(
             round_key, self._n if n is None else n,
             alive=alive, n_participants=k,
         )
-        dt = time.perf_counter() - t0
+        dt = clock.monotonic() - t0
         self._sample_pending += dt
         self.last_sample_seconds = dt
         self.totals["sample_seconds"] += dt
@@ -182,7 +216,14 @@ class CohortStreamer:
         stacks them ``[k, cohort, ...]`` — even at k=1, where the
         remainder scan still consumes a leading round axis.
         """
-        t0 = time.perf_counter()
+        with _maybe_span(
+            self.span_recorder, "prefetch_upload", "stream",
+            round_idx=self.span_round,
+        ) as _sp:
+            return self._upload_body(idx_list, stack, _sp)
+
+    def _upload_body(self, idx_list, stack: bool, _sp):
+        t0 = clock.monotonic()
         slices = [self.store.gather_data(idx) for idx in idx_list]
         if not stack:
             x, y, m, s = slices[0]
@@ -212,7 +253,10 @@ class CohortStreamer:
         # which is the point: this block runs on the worker thread, so at
         # steady state the wait overlaps the main thread's dispatch.
         jax.block_until_ready(arrays)
-        return arrays, _nbytes(host_arrays), time.perf_counter() - t0
+        nbytes = _nbytes(host_arrays)
+        if _sp is not None:
+            _sp["bytes"] = nbytes
+        return arrays, nbytes, clock.monotonic() - t0
 
     def prefetch(self, idx_list, stack: bool = False) -> None:
         """Schedule the upload for the NEXT dispatch's cohorts; returns
@@ -244,9 +288,9 @@ class CohortStreamer:
                     for a, b in zip(pend_idx, idx_list)
                 )
             ):
-                t0 = time.perf_counter()
+                t0 = clock.monotonic()
                 arrays, nbytes, dt = fut.result()
-                blocked = time.perf_counter() - t0
+                blocked = clock.monotonic() - t0
                 hidden = max(dt - blocked, 0.0)
             else:
                 # A cohort the loop no longer wants (resume/preemption
@@ -303,10 +347,10 @@ class CohortStreamer:
         fields in place when given."""
         if self.store.state is None:
             return
-        t0 = time.perf_counter()
+        t0 = clock.monotonic()
         host_state = jax.device_get(new_state_k)
         self._algorithm.scatter_client_state(self.store, idx, host_state)
-        dt = time.perf_counter() - t0
+        dt = clock.monotonic() - t0
         nbytes = tree_bytes(host_state)
         self.totals["d2h_bytes"] += nbytes
         self.totals["d2h_seconds"] += dt
@@ -432,17 +476,51 @@ class DistributedCohortStreamer(CohortStreamer):
         self.totals.update({"dcn_bytes": 0, "spill_rows": 0})
 
     # ---- exchange ----------------------------------------------------------
-    def _allgather(self, leaves, pad: int):
+    def _allgather(self, leaves, pad: int, name: str = "spill"):
         """Padded all-to-all of per-host row payloads: every host
         contributes ``pad`` rows per leaf (zeros beyond its real send
         count — every host knows every count from the shared plan, so
         no negotiation); returns leaves of shape ``[n_hosts, pad, ...]``.
-        Collective — main thread only."""
+        Collective — main thread only.
+
+        With a span recorder attached, the exchange splits into a
+        ``<name>_wait`` span (a tiny arrival-stamp allgather: its
+        duration is dominated by the SLOWEST host's arrival, and the
+        gathered aligned stamps yield the round's measured barrier skew)
+        and a ``<name>_xfer`` span (the payload allgather proper). The
+        wait span is flight-recorder eager: a host stuck here during a
+        peer's death leaves its open-line on disk for the postmortem.
+        """
         from jax.experimental import multihost_utils
 
+        from distributed_learning_simulator_tpu.parallel.multihost import (
+            allgather_wall_stamps,
+        )
+
+        _maybe_straggle()
+        rec = self.span_recorder
+        if rec is not None:
+            with rec.span(
+                f"{name}_wait", "dcn_wait", round_idx=self.span_round,
+                eager=True,
+            ) as w:
+                stamps = allgather_wall_stamps(
+                    clock.wall() - self.clock_offset_s
+                )
+                skew_ms = float(stamps.max() - stamps.min()) * 1e3
+                w["skew_ms"] = round(skew_ms, 3)
+            if self.span_round is not None:
+                rec.note_skew(self.span_round, "spill_skew_ms", skew_ms)
         padded = tuple(_pad_rows(np.asarray(a), pad) for a in leaves)
-        gathered = multihost_utils.process_allgather(padded, tiled=False)
-        nbytes = sum(int(g.nbytes) for g in gathered)
+        with _maybe_span(
+            rec, f"{name}_xfer", "dcn", round_idx=self.span_round,
+        ) as x:
+            gathered = multihost_utils.process_allgather(
+                padded, tiled=False
+            )
+            nbytes = sum(int(g.nbytes) for g in gathered)
+            if x is not None:
+                x["bytes"] = nbytes
         self.totals["dcn_bytes"] += nbytes
         return list(gathered), nbytes
 
@@ -481,7 +559,7 @@ class DistributedCohortStreamer(CohortStreamer):
         """Resolve one round's owner-sharded assembly: the global
         row-assignment plan, plus this host's data block with spill-in
         rows exchanged. Main thread (the exchange is a collective)."""
-        t0 = time.perf_counter()
+        t0 = clock.monotonic()
         p = plan_owner_assembly(
             np.asarray(idx_np, np.int64), self.store.owner_bounds,
             self._block_bounds,
@@ -491,7 +569,7 @@ class DistributedCohortStreamer(CohortStreamer):
             ex, [self.store.x, self.store.y, self.store.mask,
                  self.store.sizes],
         )
-        ex.assemble_seconds = time.perf_counter() - t0
+        ex.assemble_seconds = clock.monotonic() - t0
         self.totals["spill_rows"] += ex.total_spill
         return ex
 
@@ -559,7 +637,14 @@ class DistributedCohortStreamer(CohortStreamer):
     def _upload_plan(self, ex: _ExecPlan):
         """Worker-thread body: local device_put assembly only (the
         exchange already ran in plan(), on the main thread)."""
-        t0 = time.perf_counter()
+        with _maybe_span(
+            self.span_recorder, "prefetch_upload", "stream",
+            round_idx=self.span_round,
+        ) as _sp:
+            return self._upload_plan_body(ex, _sp)
+
+    def _upload_plan_body(self, ex: _ExecPlan, _sp):
+        t0 = clock.monotonic()
         blo = int(self._block_bounds[self._host])
         x, y, m, s = (
             self._place_block(b, self._cohort, blo) for b in ex.data_block
@@ -571,7 +656,9 @@ class DistributedCohortStreamer(CohortStreamer):
         nbytes = sum(int(b.nbytes) for b in ex.data_block) + int(
             ex.plan.idx_perm.nbytes + ex.plan.draw_pos.nbytes
         )
-        return arrays, nbytes, time.perf_counter() - t0
+        if _sp is not None:
+            _sp["bytes"] = nbytes
+        return arrays, nbytes, clock.monotonic() - t0
 
     # ---- upload / prefetch (plan-keyed double buffering) -------------------
     def prefetch_plan(self, ex: _ExecPlan) -> None:
@@ -589,9 +676,9 @@ class DistributedCohortStreamer(CohortStreamer):
             pend_ex, fut = self._pending
             self._pending = None
             if pend_ex is ex or np.array_equal(pend_ex.idx, ex.idx):
-                t0 = time.perf_counter()
+                t0 = clock.monotonic()
                 arrays, nbytes, dt = fut.result()
-                blocked = time.perf_counter() - t0
+                blocked = clock.monotonic() - t0
                 hidden = max(dt - blocked, 0.0)
                 ex = pend_ex
             else:
@@ -647,7 +734,7 @@ class DistributedCohortStreamer(CohortStreamer):
         through the reverse exchange. Main thread (collective)."""
         if self.store.state is None:
             return
-        t0 = time.perf_counter()
+        t0 = clock.monotonic()
 
         def local_rows(leaf):
             shards = sorted(
@@ -670,7 +757,9 @@ class DistributedCohortStreamer(CohortStreamer):
         dcn = 0
         if ex.total_spill:
             send = [l[ex.in_rows_rel] for l in leaves]
-            gathered, dcn = self._allgather(send, ex.pad_back)
+            gathered, dcn = self._allgather(
+                send, ex.pad_back, name="writeback"
+            )
             if ex.out_ids.size:
                 mine = [
                     g[ex.out_block, ex.out_slot] for g in gathered
@@ -679,7 +768,7 @@ class DistributedCohortStreamer(CohortStreamer):
                     self.store, ex.out_ids,
                     jax.tree_util.tree_unflatten(treedef, mine),
                 )
-        dt = time.perf_counter() - t0
+        dt = clock.monotonic() - t0
         nbytes = sum(int(l.nbytes) for l in leaves)
         self.totals["d2h_bytes"] += nbytes
         self.totals["d2h_seconds"] += dt
@@ -694,7 +783,7 @@ class DistributedCohortStreamer(CohortStreamer):
         slice into its addressable shards of the full-N client axis
         (owner bounds are the device blocks by construction —
         data/residency.host_axis_bounds). Zero DCN traffic."""
-        t0 = time.perf_counter()
+        t0 = clock.monotonic()
         x, y, m, s = self.store.gather_data(None)
         n = int(self.store.owner_bounds[-1])
         arrays = tuple(
@@ -703,7 +792,7 @@ class DistributedCohortStreamer(CohortStreamer):
         ) + (None,)
         jax.block_until_ready([a for a in arrays if a is not None])
         nbytes = self.store.data_bytes()
-        dt = time.perf_counter() - t0
+        dt = clock.monotonic() - t0
         self.totals["h2d_bytes"] += nbytes
         self.totals["h2d_seconds"] += dt
         stats = {

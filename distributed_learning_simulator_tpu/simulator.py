@@ -75,8 +75,11 @@ from distributed_learning_simulator_tpu.telemetry import (
     ClientStats,
     ClientValuation,
     RecompileMonitor,
+    SpanPhaseTimer,
+    SpanRecorder,
     ValuationAuditor,
     ValuationState,
+    clock,
     costmodel_record,
     detect_and_record,
     hbm_limit_bytes,
@@ -539,10 +542,18 @@ def run_simulation(
     and pass it in.
     """
     config.validate()
+    # Cross-host clock alignment for span journals (telemetry/spans.py):
+    # zeros for single-process runs; estimated once right after the
+    # jax.distributed init barrier when tracing is on (the one moment
+    # every host is provably inside the same code region).
+    span_on = config.span_trace.lower() == "on"
+    span_clock_offset = 0.0
+    span_clock_unc = 0.0
     if config.multihost:
         # Before ANY device query or dispatch: jax.distributed must come up
         # first so the default backend enumerates every host's devices.
         from distributed_learning_simulator_tpu.parallel.multihost import (
+            estimate_clock_alignment,
             initialize_multihost,
         )
 
@@ -551,6 +562,8 @@ def run_simulation(
             num_processes=config.num_processes,
             process_id=config.process_id,
         )
+        if span_on:
+            span_clock_offset, span_clock_unc = estimate_clock_alignment()
     # Compilation-cache config comes BEFORE the execution-mode dispatch so
     # threaded runs (whose per-client local_train is jitted too) get the
     # persistent cache as well.
@@ -1363,6 +1376,39 @@ def run_simulation(
     phase_timer = make_phase_timer(tel_level)
     recompile = RecompileMonitor() if tel_level != "off" else None
     post_warmup_compiles = {"count": 0} if recompile is not None else None
+    # Distributed tracing (telemetry/spans.py): the per-host span
+    # recorder + its journal, and the SpanPhaseTimer proxy that makes
+    # every phase boundary a span at ANY telemetry_level. None at the
+    # default 'off' — the exact pre-feature program (off-gate contract).
+    span_recorder = None
+    if span_on:
+        span_recorder = SpanRecorder(
+            host_id=jax.process_index(), n_hosts=jax.process_count(),
+            capacity=config.span_buffer_size,
+            flush_last_k=config.span_flush_last_k,
+        )
+        span_journal_dir = config.span_dir or log_dir
+        if span_journal_dir:
+            logger.info(
+                "span journal: %s (clock offset %+.6fs ± %.6fs vs host 0)",
+                span_recorder.attach(
+                    span_journal_dir, span_clock_offset, span_clock_unc
+                ),
+                span_clock_offset, span_clock_unc,
+            )
+        else:
+            # Non-primary hosts have no artifacts dir; without span_dir
+            # the ring still works as a pure in-memory flight recorder,
+            # but nothing persists — say so rather than silently drop.
+            logger.warning(
+                "span_trace='on' but this host has no artifacts dir and "
+                "no span_dir; span journal disabled (in-memory flight "
+                "recorder only) — set span_dir to a shared directory"
+            )
+        phase_timer = SpanPhaseTimer(phase_timer, span_recorder)
+        if streamer is not None:
+            streamer.span_recorder = span_recorder
+            streamer.clock_offset_s = span_clock_offset
     # Per-client statistics (telemetry/client_stats.py): the round program
     # computes the [N, S] stats matrix in-program when on; the host fetches
     # it on the client_stats_every cadence inside the round's single metric
@@ -1456,6 +1502,9 @@ def run_simulation(
         discipline at shard granularity."""
         from jax.experimental import multihost_utils
 
+        from distributed_learning_simulator_tpu.parallel.multihost import (
+            allgather_wall_stamps,
+        )
         from distributed_learning_simulator_tpu.utils.checkpoint import (
             gc_sharded_checkpoints,
             save_shard_checkpoint,
@@ -1479,7 +1528,26 @@ def run_simulation(
                     jax.random.key_data(rng_key)
                 ),
             },
+            span_recorder=span_recorder,
         )
+        if span_recorder is not None:
+            # Checkpoint-barrier skew: a tiny aligned-arrival allgather
+            # ahead of the agreement barrier — its wall is dominated by
+            # the slowest host's shard write, and the gathered stamps
+            # are the round's measured ckpt_skew_ms. Flight-recorder
+            # eager: a host stuck here during a peer's death leaves its
+            # open-line on disk. The skew is parked as pending (this
+            # round's record already shipped) and rides the next one.
+            wid = span_recorder.begin(
+                "ckpt_barrier_wait", "dcn_wait", round_idx=round_idx,
+                eager=True,
+            )
+            stamps = allgather_wall_stamps(
+                clock.wall() - span_clock_offset
+            )
+            skew_ms = float(stamps.max() - stamps.min()) * 1e3
+            span_recorder.end(wid, skew_ms=round(skew_ms, 3))
+            span_recorder.note_pending_skew("ckpt_skew_ms", skew_ms)
         agreed = multihost_utils.process_allgather(
             np.asarray([round_idx], dtype=np.int64)
         )
@@ -1506,6 +1574,7 @@ def run_simulation(
                         for h in range(n_procs)
                     ],
                 },
+                span_recorder=span_recorder,
             )
             gc_sharded_checkpoints(
                 config.checkpoint_dir, config.checkpoint_keep_last
@@ -1558,6 +1627,11 @@ def run_simulation(
                 round_idx, record.get("survivor_count"),
                 config.min_survivors,
             )
+            if span_recorder is not None:
+                # Flight-recorder trigger: a quorum rejection is a
+                # fault event — snapshot what every subsystem was doing
+                # around it into the journal for the postmortem.
+                span_recorder.flush_inflight("quorum_rejected")
         t_prev_done = now
         cs_rec = None
         extras = {
@@ -1684,17 +1758,24 @@ def run_simulation(
             if pop_rec["rejected_by_churn"]:
                 telemetry["churn_rejected"] += 1
         tel_rec = tel_rec_fn()
+        spans_rec = None
+        if span_recorder is not None:
+            # Pop the round's span aggregate for the schema-v12
+            # sub-object, then drain completed spans to the journal —
+            # once per round, the only hot-path journal I/O.
+            spans_rec = span_recorder.round_summary(round_idx)
+            span_recorder.flush()
         if (
             tel_rec is not None or cs_rec is not None
             or async_rec is not None or stream_rec is not None
             or cm_rec is not None or val_rec is not None
             or pop_rec is not None or gtg_rec is not None
-            or multihost_rec is not None
+            or multihost_rec is not None or spans_rec is not None
         ):
             record = build_round_record(
                 record, tel_rec, cs_rec, async_rec, stream_rec, cm_rec,
                 val_rec, population=pop_rec, gtg=gtg_rec,
-                multihost=multihost_rec,
+                multihost=multihost_rec, spans=spans_rec,
             )
         history.append(record)
         if metrics_path:
@@ -1708,6 +1789,20 @@ def run_simulation(
         prev_metrics = metrics
 
     def finalize(p: dict) -> None:
+        # Flight-recorder envelope: an EAGER span (open-line journaled
+        # before the body runs) covering metric fetch, record emission,
+        # and the checkpoint block — the chaos harness's injected crash
+        # (maybe_crash, last statement below) fires inside it, so a
+        # SIGKILL'd host's journal names this span as its in-flight
+        # postmortem without any cleanup code running.
+        if span_recorder is None:
+            return _finalize(p)
+        with span_recorder.span(
+            "finalize", "round", round_idx=p["round_idx"], eager=True,
+        ):
+            return _finalize(p)
+
+    def _finalize(p: dict) -> None:
         tel_keys = [
             k for k in ("survivor_count", "round_rejected", "participants")
             if k in p["aux"]
@@ -1781,6 +1876,15 @@ def run_simulation(
             # anything later is the shape-instability warning.
             recompile.attribute(p["round_idx"])
             events = recompile.take(p["round_idx"])
+            if span_recorder is not None:
+                # Recompile events become instant spans: on the stitched
+                # timeline a post-warmup compile shows up AT the host
+                # and round that paid for it.
+                for _fn_name, _secs in events:
+                    span_recorder.event(
+                        _fn_name, "compile", round_idx=p["round_idx"],
+                        seconds=round(_secs, 6),
+                    )
             n_compiles = log_round_compiles(
                 logger, p["round_idx"], events,
                 warmup=p["round_idx"] == start_round,
@@ -2186,11 +2290,11 @@ def run_simulation(
                                     # host cost out of client_step into
                                     # the `sample` phase (K=1 rationale
                                     # above).
-                                    _t_s = time.perf_counter()
+                                    _t_s = clock.monotonic()
                                     idx2, hk2 = _stream_plan(hk_after, k2)
                                     phase_timer.carve(
                                         last_idx, "sample",
-                                        time.perf_counter() - _t_s,
+                                        clock.monotonic() - _t_s,
                                         "client_step",
                                     )
                                     stream_next = (nxt, idx2, hk2)
@@ -2277,6 +2381,11 @@ def run_simulation(
                         )
                         profile_from = None
                     key, round_key = jax.random.split(key)
+                    if span_recorder is not None and streamer is not None:
+                        # Skew/occupancy spans emitted inside the
+                        # streamer (spill exchange, prefetch worker)
+                        # attribute to the round being dispatched.
+                        streamer.span_round = round_idx
                     with annotate(f"fl_round_{round_idx}"), _oom_hint(
                         config, global_params, n_clients
                     ):
@@ -2436,13 +2545,13 @@ def run_simulation(
                                         # across processes; only the
                                         # device_put assembly rides the
                                         # worker thread.
-                                        _t_s = time.perf_counter()
+                                        _t_s = clock.monotonic()
                                         stream_next_idx = streamer.plan(
                                             streamer.cohort_for(_nxt_rk)
                                         )
                                         phase_timer.carve(
                                             round_idx, "sample",
-                                            time.perf_counter() - _t_s,
+                                            clock.monotonic() - _t_s,
                                             "client_step",
                                         )
                                         streamer.prefetch_plan(
@@ -2587,6 +2696,23 @@ def run_simulation(
                         # with pipelining its deferred finalize runs in the
                         # crash-flush below); no new round is dispatched.
                         break
+        except BaseException as crash_exc:
+            # Flight recorder (telemetry/spans.py): an unhandled crash
+            # force-flushes the last-K spans plus every still-open span
+            # with its `inflight` marker — the journal then names
+            # exactly what this host was doing when the run died (a
+            # peer's SIGKILL surfacing as a broken collective lands
+            # here too). Best-effort by construction: flush_inflight
+            # never raises past its own I/O, and the original exception
+            # always propagates.
+            if span_recorder is not None:
+                try:
+                    span_recorder.flush_inflight(
+                        type(crash_exc).__name__
+                    )
+                except Exception:
+                    pass
+            raise
         finally:
             if sigterm_installed:
                 signal.signal(signal.SIGTERM, prev_sigterm)
@@ -2615,6 +2741,11 @@ def run_simulation(
         # finalized above; persist it even off the checkpoint_every
         # cadence so the resumed run loses nothing, then exit cleanly.
         preempted_at = completed_round
+        if span_recorder is not None:
+            # Flight recorder: journal the preemption moment (last-K
+            # spans + anything still open) so a postmortem can see what
+            # the SIGTERM interrupted even though the exit is clean.
+            span_recorder.flush_inflight("sigterm")
         if mh and config.checkpoint_dir:
             # No off-cadence force-write under the distributed store:
             # the sharded commit needs a cross-host barrier, and SIGTERM
@@ -2661,6 +2792,14 @@ def run_simulation(
                 "configured, exiting cleanly without persisting",
                 completed_round,
             )
+
+    span_summary = None
+    if span_recorder is not None:
+        # Final journal drain + close; the run summary is what bench.py's
+        # mhost leg and scripts read (run-total counts, seconds by
+        # category, and the worst barrier skews seen).
+        span_summary = span_recorder.run_summary()
+        span_recorder.close()
 
     total = time.perf_counter() - t_start
     # len(history) counts THIS run's finalized rounds (a preempted run
@@ -2806,6 +2945,11 @@ def run_simulation(
             pop.summary(telemetry["churn_rejected"])
             if pop is not None else None
         ),
+        # Distributed tracing (telemetry/spans.py): this host's span
+        # journal path + run-total span counts and worst barrier skews —
+        # None when span_trace='off', the off-gate convention.
+        "span_trace": config.span_trace,
+        "span_summary": span_summary,
         "preempted_at": preempted_at,
     }
 

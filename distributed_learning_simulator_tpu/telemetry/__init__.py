@@ -11,6 +11,15 @@ engine) reports through:
   regions, with ``block_until_ready`` fencing only when
   ``telemetry_level='detailed'`` asks for it, so the default program is
   untouched.
+* :mod:`.clock` + :mod:`.spans` — the distributed tracing layer:
+  the ONE monotonic/wall clock convention every timing subsystem
+  shares, and the per-host span recorder (``span_trace='on'``) that
+  journals phase boundaries, DCN barrier waits (the cross-host skew
+  signal), prefetch worker occupancy, and checkpoint barriers to
+  ``spans_<host_id>.jsonl`` — doubling as a crash flight recorder;
+  ``scripts/trace_timeline.py`` stitches all hosts' journals into a
+  perfetto-loadable timeline (docs/OBSERVABILITY.md § Distributed
+  tracing).
 * :mod:`.recompile` — an XLA recompilation counter hooked on
   ``jax.monitoring`` compile events (names recovered from the
   ``jax_log_compiles`` log stream): any compile after the warmup round
@@ -72,6 +81,11 @@ from distributed_learning_simulator_tpu.telemetry.recompile import (
     RecompileMonitor,
     log_round_compiles,
 )
+from distributed_learning_simulator_tpu.telemetry.spans import (
+    SpanPhaseTimer,
+    SpanRecorder,
+    journal_filename,
+)
 from distributed_learning_simulator_tpu.telemetry.topologies import (
     TOPOLOGIES,
     Topology,
@@ -101,6 +115,8 @@ __all__ = [
     "NullPhaseTimer",
     "PhaseTimer",
     "RecompileMonitor",
+    "SpanPhaseTimer",
+    "SpanRecorder",
     "Topology",
     "ValuationAuditor",
     "ValuationState",
@@ -113,6 +129,7 @@ __all__ = [
     "device_memory_stats",
     "get_topology",
     "hbm_limit_bytes",
+    "journal_filename",
     "ledger_totals",
     "log_round_compiles",
     "make_phase_timer",

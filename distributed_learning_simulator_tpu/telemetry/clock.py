@@ -1,0 +1,55 @@
+"""One clock for every timing subsystem (spans, phases, stream records).
+
+Before this module each timing consumer hand-rolled its own calls —
+``telemetry/phases.py`` used ``time.perf_counter()``, the streaming
+prefetch records used another set, the chaos/report tooling a third.
+That worked while every number stayed host-local, but the distributed
+tracing layer (``telemetry/spans.py``) must relate timestamps ACROSS
+hosts, which needs one explicit convention:
+
+* :func:`monotonic` — the intra-host span/phase clock. Monotonic,
+  unaffected by NTP steps; meaningless across hosts (each host's
+  monotonic epoch is arbitrary, typically boot time).
+* :func:`wall` — UNIX epoch seconds. Comparable across hosts up to NTP
+  error; used ONLY to anchor each host's monotonic epoch in the span
+  journal header, never for durations.
+
+A journal header records the pair ``(epoch_wall, epoch_mono)`` sampled
+back-to-back plus the barrier-estimated ``clock_offset_s`` vs host 0
+(``parallel/multihost.estimate_clock_alignment``).  The stitcher maps a
+host-local monotonic stamp ``t`` onto the shared timeline as::
+
+    aligned = (t - epoch_mono) + epoch_wall - clock_offset_s
+
+:func:`align` implements exactly that (pure math, jax-free) so the
+recorder, the stitcher, and the tests cannot drift apart on sign
+conventions.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def monotonic() -> float:
+    """Host-local monotonic seconds (``time.perf_counter``): durations
+    and span begin/end stamps. Never comparable across hosts."""
+    return time.perf_counter()
+
+
+def wall() -> float:
+    """UNIX epoch seconds (``time.time``): cross-host anchoring only —
+    NTP may step it, so never subtract two wall stamps for a duration."""
+    return time.time()
+
+
+def align(t_mono: float, epoch_mono: float, epoch_wall: float,
+          clock_offset_s: float = 0.0) -> float:
+    """Map a host-local monotonic stamp onto the shared wall timeline.
+
+    ``clock_offset_s`` is THIS host's wall-clock offset relative to host
+    0 (positive = this host's wall clock reads ahead), as estimated by
+    ``estimate_clock_alignment`` — subtracting it expresses the stamp in
+    host 0's wall time, the common axis all journals stitch onto.
+    """
+    return (t_mono - epoch_mono) + epoch_wall - clock_offset_s

@@ -29,9 +29,10 @@ contexts are no-ops — the default program is untouched.
 from __future__ import annotations
 
 import contextlib
-import time
 
 import jax
+
+from distributed_learning_simulator_tpu.telemetry import clock
 
 
 class _FenceBox:
@@ -66,13 +67,13 @@ class PhaseTimer:
     @contextlib.contextmanager
     def phase(self, round_idx: int, name: str):
         box = _FenceBox()
-        t0 = time.perf_counter()
+        t0 = clock.monotonic()
         try:
             yield box
         finally:
             if self._fence and box.value is not None:
                 jax.block_until_ready(box.value)
-            dt = time.perf_counter() - t0
+            dt = clock.monotonic() - t0
             acc = self._acc.setdefault(round_idx, {})
             acc[name] = acc.get(name, 0.0) + dt
 

@@ -1,0 +1,456 @@
+"""Cross-host distributed tracing: span journals + crash flight recorder.
+
+PR 15 made the simulator genuinely distributed, but every telemetry
+layer stayed single-process: no way to see which HOST stalled an
+allgather, how skewed barrier arrivals are, or what a killed host was
+doing when it died. This module is the per-host half of the fix:
+
+* :class:`SpanRecorder` — a trace-time-cheap structured span recorder.
+  ``begin``/``end`` stamp ``clock.monotonic()`` and append one dict to a
+  bounded in-memory ring (``deque(maxlen=...)``; overflow counts into
+  ``dropped``, never blocks the hot path). ``flush()`` drains completed
+  spans to a per-host ``spans_<host_id>.jsonl`` journal once per round.
+* Flight recorder — the same ring, read out under failure. Spans marked
+  ``eager=True`` (the per-round envelope, DCN barrier waits, checkpoint
+  barriers — anything that can deadlock or die mid-span) additionally
+  write an ``open`` journal line at BEGIN, flushed to the OS before the
+  span body runs: a SIGKILL'd process leaves its open-line on disk, so
+  the postmortem names the span it died inside without any cleanup code
+  running. ``flush_inflight(reason)`` is the soft-failure path (SIGTERM,
+  fault-quorum rejection, unhandled crash): last-K completed spans +
+  a ``flight`` marker + one ``inflight`` line per still-open span.
+* :class:`SpanPhaseTimer` — a proxy wrapping the existing
+  :class:`~..telemetry.phases.PhaseTimer` (or its Null twin) so every
+  phase boundary emits begin/end spans at ANY ``telemetry_level``,
+  without touching the phase-accounting contract.
+
+Journal line taxonomy (all JSONL, one object per line):
+
+``header``   host identity + clock anchors (``epoch_wall``/``epoch_mono``
+             sampled back-to-back) + ``clock_offset_s`` /
+             ``clock_uncertainty_s`` vs host 0 — everything
+             ``scripts/trace_timeline.py`` needs to stitch journals.
+``open``     eager begin marker (flight recorder); matched by a later
+             ``span`` line with the same ``id`` unless the host died.
+``span``     completed span: ``t0`` (monotonic), ``dur`` seconds.
+``event``    instant event (recompiles, dispatch marks).
+``flight``   force-flush marker with the triggering ``reason`` and, when
+             an exception unwound through a span first, the ``in_span``
+             it escaped from (name/cat/round + exception type).
+``inflight`` a span still open at force-flush time.
+
+Span categories (``cat``): ``round`` (per-round envelope), ``phase``
+(PhaseTimer phases), ``dcn_wait`` (barrier arrival waits — the skew
+signal), ``dcn`` (payload collectives), ``io`` (checkpoint shard
+writes), ``stream`` (prefetch worker occupancy), ``compile`` (recompile
+events), ``dispatch``. ``round_summary()`` folds a round's spans into
+the schema-v12 ``spans`` record sub-object (``utils/reporting.py``).
+
+Everything here is jax-free and thread-safe (the streaming prefetch
+worker emits occupancy spans from its own thread).
+
+``span_trace='off'`` (default) constructs none of this — the simulator
+keeps the exact pre-feature program (off-gate contract).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+
+from distributed_learning_simulator_tpu.telemetry import clock
+
+JOURNAL_VERSION = 1
+
+#: Journal filename for a host, next to metrics.jsonl in the artifacts
+#: (or ``span_dir``) directory. The stitcher globs this pattern.
+JOURNAL_PATTERN = "spans_{host_id}.jsonl"
+
+
+def journal_filename(host_id: int) -> str:
+    return JOURNAL_PATTERN.format(host_id=int(host_id))
+
+
+class SpanRecorder:
+    """Bounded in-memory span ring + per-host JSONL journal.
+
+    Hot-path cost is one dict build and a deque append under a lock;
+    journal I/O happens only in ``flush()`` (once per round), at eager
+    begins (a handful per round), and in the failure paths.
+    """
+
+    def __init__(self, host_id: int = 0, n_hosts: int = 1,
+                 capacity: int = 4096, flush_last_k: int = 64):
+        if capacity < 1:
+            raise ValueError(f"span buffer capacity must be >= 1: {capacity}")
+        if flush_last_k < 1:
+            raise ValueError(f"flush_last_k must be >= 1: {flush_last_k}")
+        self.host_id = int(host_id)
+        self.n_hosts = int(n_hosts)
+        self.capacity = int(capacity)
+        self.flush_last_k = int(flush_last_k)
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._open: dict[int, dict] = {}
+        self._next_id = 0
+        self._dropped = 0
+        self._round_agg: dict[int, dict] = {}
+        # Skews measured after a round's record already shipped (the
+        # checkpoint barrier runs post-emit): parked here and merged
+        # into the NEXT round_summary — "the most recent checkpoint
+        # barrier's skew", never silently dropped.
+        self._pending_skews: dict[str, float] = {}
+        # Run-level aggregate for the result dict's span_summary.
+        self._run = {"count": 0, "by_cat": {}, "skews": {}}
+        # The innermost span an exception unwound through: by the time
+        # the crash handler calls flush_inflight, every context-managed
+        # span has already closed on the unwind, so this is the only
+        # record of WHERE the failure struck — stamped onto the flight
+        # marker as ``in_span``.
+        self._last_error: dict | None = None
+        self._file = None
+        self.journal_path: str | None = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # journal attachment
+
+    def attach(self, directory: str, clock_offset_s: float = 0.0,
+               clock_uncertainty_s: float = 0.0) -> str:
+        """Open ``spans_<host_id>.jsonl`` under ``directory`` and write
+        the header line (clock anchors + alignment). Returns the path."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, journal_filename(self.host_id))
+        # Anchor the monotonic epoch: sample wall and monotonic
+        # back-to-back so (epoch_wall, epoch_mono) name the same instant
+        # up to a few microseconds.
+        epoch_wall = clock.wall()
+        epoch_mono = clock.monotonic()
+        header = {
+            "kind": "header",
+            "journal_version": JOURNAL_VERSION,
+            "host_id": self.host_id,
+            "n_hosts": self.n_hosts,
+            "pid": os.getpid(),
+            "epoch_wall": epoch_wall,
+            "epoch_mono": epoch_mono,
+            "clock_offset_s": float(clock_offset_s),
+            "clock_uncertainty_s": float(clock_uncertainty_s),
+            "span_trace": "on",
+        }
+        with self._lock:
+            self._file = open(path, "w", encoding="utf-8")
+            self.journal_path = path
+            self._file.write(json.dumps(header) + "\n")
+            self._file.flush()
+        return path
+
+    # ------------------------------------------------------------------
+    # span emission
+
+    def begin(self, name: str, cat: str, round_idx: int | None = None,
+              eager: bool = False, **attrs) -> int:
+        """Open a span; returns its id for :meth:`end`.
+
+        ``eager=True`` writes an ``open`` journal line immediately and
+        flushes it to the OS — the flight-recorder guarantee that a
+        SIGKILL mid-span still leaves the span's identity on disk.
+        """
+        t0 = clock.monotonic()
+        span = {"id": -1, "name": name, "cat": cat, "t0": t0}
+        if round_idx is not None:
+            span["round"] = int(round_idx)
+        if attrs:
+            span["attrs"] = attrs
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            span["id"] = sid
+            self._open[sid] = span
+            if eager and self._file is not None:
+                line = {"kind": "open", **{k: span[k] for k in span
+                                           if k != "attrs"}}
+                if attrs:
+                    line["attrs"] = attrs
+                self._file.write(json.dumps(line) + "\n")
+                self._file.flush()
+        return sid
+
+    def end(self, span_id: int, **attrs) -> float:
+        """Close a span; returns its duration in seconds. Extra attrs
+        merge into the span record (e.g. measured skew on a wait)."""
+        t1 = clock.monotonic()
+        with self._lock:
+            span = self._open.pop(span_id, None)
+            if span is None:
+                return 0.0
+            dur = t1 - span["t0"]
+            span["dur"] = dur
+            if attrs:
+                span.setdefault("attrs", {}).update(attrs)
+            self._append_locked(span)
+            self._aggregate_locked(span)
+        return dur
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str, round_idx: int | None = None,
+             eager: bool = False, **attrs):
+        """Context-manager form of begin/end. Yields a dict the body may
+        mutate to attach result attrs (e.g. byte counts)."""
+        extra: dict = {}
+        sid = self.begin(name, cat, round_idx=round_idx, eager=eager,
+                         **attrs)
+        try:
+            yield extra
+        except BaseException as e:
+            # Remember the innermost span this exception escaped from —
+            # the span itself closes below (clean journals), but the
+            # flight marker needs to name where the failure struck.
+            err = {"name": name, "cat": cat, "error": type(e).__name__}
+            if round_idx is not None:
+                err["round"] = int(round_idx)
+            with self._lock:
+                if self._last_error is None:
+                    self._last_error = err
+            raise
+        finally:
+            self.end(sid, **extra)
+
+    def event(self, name: str, cat: str, round_idx: int | None = None,
+              **attrs) -> None:
+        """Instant event (zero-duration mark: recompile, dispatch)."""
+        ev = {"kind": "event", "name": name, "cat": cat,
+              "t": clock.monotonic()}
+        if round_idx is not None:
+            ev["round"] = int(round_idx)
+        if attrs:
+            ev["attrs"] = attrs
+        with self._lock:
+            self._append_locked(ev)
+            self._run["count"] += 1
+            if round_idx is not None:
+                agg = self._agg_for_locked(round_idx)
+                agg["count"] += 1
+
+    def note_skew(self, round_idx: int, key: str, skew_ms: float) -> None:
+        """Record a measured barrier skew (``spill_skew_ms`` /
+        ``ckpt_skew_ms``) into the round's summary. Max-aggregated: the
+        worst skew a round saw is the one that bounds its critical path."""
+        with self._lock:
+            agg = self._agg_for_locked(round_idx)
+            prev = agg["skews"].get(key)
+            if prev is None or skew_ms > prev:
+                agg["skews"][key] = float(skew_ms)
+            self._note_run_skew_locked(key, skew_ms)
+
+    def note_pending_skew(self, key: str, skew_ms: float) -> None:
+        """Like :meth:`note_skew` for a barrier that ran AFTER its
+        round's record shipped (the checkpoint barrier): merged into the
+        next :meth:`round_summary` instead of a specific round's."""
+        with self._lock:
+            prev = self._pending_skews.get(key)
+            if prev is None or skew_ms > prev:
+                self._pending_skews[key] = float(skew_ms)
+            self._note_run_skew_locked(key, skew_ms)
+
+    # ------------------------------------------------------------------
+    # draining
+
+    def flush(self) -> int:
+        """Drain completed spans/events to the journal. Returns the
+        number of lines written (0 when unattached — the ring then just
+        keeps the last ``capacity`` entries as a pure flight recorder)."""
+        with self._lock:
+            if self._file is None:
+                return 0
+            n = 0
+            while self._ring:
+                rec = self._ring.popleft()
+                self._file.write(json.dumps(self._line_locked(rec)) + "\n")
+                n += 1
+            if n:
+                self._file.flush()
+            return n
+
+    def flush_inflight(self, reason: str) -> int:
+        """Force-flush for the failure paths (SIGTERM, quorum rejection,
+        unhandled crash): last-K completed spans, a ``flight`` marker
+        carrying ``reason``, then one ``inflight`` line per open span.
+        Safe to call multiple times and with no journal attached."""
+        with self._lock:
+            if self._file is None or self._closed:
+                return 0
+            n = 0
+            tail = list(self._ring)[-self.flush_last_k:]
+            self._ring.clear()
+            for rec in tail:
+                self._file.write(json.dumps(self._line_locked(rec)) + "\n")
+                n += 1
+            flight = {
+                "kind": "flight", "reason": str(reason),
+                "t": clock.monotonic(), "wall": clock.wall(),
+            }
+            if self._last_error is not None:
+                flight["in_span"] = self._last_error
+            self._file.write(json.dumps(flight) + "\n")
+            n += 1
+            for span in self._open.values():
+                line = {"kind": "inflight", "inflight": True,
+                        **{k: span[k] for k in span}}
+                self._file.write(json.dumps(line) + "\n")
+                n += 1
+            self._file.flush()
+            try:
+                os.fsync(self._file.fileno())
+            except OSError:
+                pass
+            return n
+
+    def close(self) -> None:
+        """Final drain + close the journal (idempotent)."""
+        self.flush()
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                    self._file.close()
+                finally:
+                    self._file = None
+
+    # ------------------------------------------------------------------
+    # per-round summary (schema-v12 `spans` sub-object)
+
+    def round_summary(self, round_idx: int) -> dict:
+        """Pop the round's aggregate as the metrics-record sub-object.
+        Pending post-emit skews (checkpoint barrier) merge in here."""
+        with self._lock:
+            agg = self._round_agg.pop(int(round_idx), None)
+            dropped = self._dropped
+            pending = self._pending_skews
+            self._pending_skews = {}
+        rec = {
+            "host_id": self.host_id,
+            "hosts": self.n_hosts,
+            "count": 0 if agg is None else int(agg["count"]),
+        }
+        if dropped:
+            rec["dropped"] = int(dropped)
+        if agg is not None:
+            if agg["by_cat"]:
+                rec["seconds_by_cat"] = {
+                    k: round(v, 6) for k, v in sorted(agg["by_cat"].items())
+                }
+            rec["dcn_wait_s"] = round(agg["by_cat"].get("dcn_wait", 0.0), 6)
+            rec["dcn_transfer_s"] = round(agg["by_cat"].get("dcn", 0.0), 6)
+            skews = dict(agg["skews"])
+        else:
+            skews = {}
+        for k, v in pending.items():
+            if skews.get(k) is None or v > skews[k]:
+                skews[k] = v
+        if agg is not None or skews:
+            rec["spill_skew_ms"] = skews.get("spill_skew_ms")
+            rec["ckpt_skew_ms"] = skews.get("ckpt_skew_ms")
+        return rec
+
+    def run_summary(self) -> dict:
+        """Whole-run aggregate for the result dict's ``span_summary``
+        (bench.py's mhost leg and the 2-process tests read it)."""
+        with self._lock:
+            run = {
+                "count": int(self._run["count"]),
+                "dropped": int(self._dropped),
+                "by_cat": dict(self._run["by_cat"]),
+                "skews": dict(self._run["skews"]),
+            }
+        return {
+            "host_id": self.host_id,
+            "hosts": self.n_hosts,
+            "journal_path": self.journal_path,
+            "count": run["count"],
+            "dropped": run["dropped"],
+            "seconds_by_cat": {
+                k: round(v, 6) for k, v in sorted(run["by_cat"].items())
+            },
+            "dcn_wait_s": round(run["by_cat"].get("dcn_wait", 0.0), 6),
+            "dcn_transfer_s": round(run["by_cat"].get("dcn", 0.0), 6),
+            "spill_skew_ms_max": run["skews"].get("spill_skew_ms"),
+            "ckpt_skew_ms_max": run["skews"].get("ckpt_skew_ms"),
+        }
+
+    # ------------------------------------------------------------------
+    # internals (call with self._lock held)
+
+    def _append_locked(self, rec: dict) -> None:
+        if len(self._ring) == self._ring.maxlen:
+            self._dropped += 1
+        self._ring.append(rec)
+
+    def _agg_for_locked(self, round_idx: int) -> dict:
+        return self._round_agg.setdefault(int(round_idx), {
+            "count": 0, "by_cat": {}, "skews": {},
+        })
+
+    def _aggregate_locked(self, span: dict) -> None:
+        cat = span.get("cat", "")
+        dur = span.get("dur", 0.0)
+        self._run["count"] += 1
+        self._run["by_cat"][cat] = self._run["by_cat"].get(cat, 0.0) + dur
+        rnd = span.get("round")
+        if rnd is None:
+            return
+        agg = self._agg_for_locked(rnd)
+        agg["count"] += 1
+        agg["by_cat"][cat] = agg["by_cat"].get(cat, 0.0) + dur
+
+    def _note_run_skew_locked(self, key: str, skew_ms: float) -> None:
+        prev = self._run["skews"].get(key)
+        if prev is None or skew_ms > prev:
+            self._run["skews"][key] = float(skew_ms)
+
+    @staticmethod
+    def _line_locked(rec: dict) -> dict:
+        if rec.get("kind") == "event":
+            return rec
+        return {"kind": "span", **rec}
+
+
+class SpanPhaseTimer:
+    """PhaseTimer proxy: same phase-accounting contract, plus a span per
+    phase. Wraps either timer class — spans work at any
+    ``telemetry_level``, including 'off' (the Null inner still yields
+    its inert fence box; only the span clocks run)."""
+
+    def __init__(self, inner, recorder: SpanRecorder):
+        self._inner = inner
+        self._rec = recorder
+
+    @property
+    def enabled(self) -> bool:
+        return self._inner.enabled
+
+    @contextlib.contextmanager
+    def phase(self, round_idx: int, name: str):
+        # Dispatch boundary: the client_step phase entry IS where the
+        # round program is handed to the runtime — an instant event so
+        # the timeline marks it even under async dispatch (where the
+        # phase's duration is trace+dispatch cost, not device time).
+        if name == "client_step":
+            self._rec.event("dispatch", "dispatch", round_idx=round_idx)
+        # Span outside the inner phase: a fencing timer's
+        # block_until_ready runs before the span closes, so 'detailed'
+        # mode spans measure true device time like the phase table does.
+        with self._rec.span(name, "phase", round_idx=round_idx):
+            with self._inner.phase(round_idx, name) as box:
+                yield box
+
+    def take(self, round_idx: int):
+        return self._inner.take(round_idx)
+
+    def carve(self, round_idx: int, name: str, seconds: float,
+              source: str) -> None:
+        self._inner.carve(round_idx, name, seconds, source)

@@ -225,33 +225,57 @@ def manifest_checkpoint_path(directory: str, round_idx: int) -> str:
 
 
 def save_shard_checkpoint(directory: str, round_idx: int, host_id: int,
-                          n_hosts: int, payload: dict) -> str:
-    """Write this host's checkpoint shard (CRC-framed, atomic)."""
+                          n_hosts: int, payload: dict,
+                          span_recorder=None) -> str:
+    """Write this host's checkpoint shard (CRC-framed, atomic).
+
+    ``span_recorder`` (telemetry/spans.SpanRecorder, span_trace='on'):
+    the write lands as a per-host ``ckpt_shard_write`` io span — the
+    per-host half of the checkpoint-barrier skew story (a slow disk here
+    shows up as the OTHER hosts' ``ckpt_barrier_wait``)."""
     payload = dict(payload)
     payload["round_idx"] = round_idx
     payload["host_id"] = host_id
     payload["n_hosts"] = n_hosts
-    return _write_framed(
-        shard_checkpoint_path(directory, round_idx, host_id, n_hosts),
-        payload,
-    )
+    path = shard_checkpoint_path(directory, round_idx, host_id, n_hosts)
+    if span_recorder is None:
+        return _write_framed(path, payload)
+    with span_recorder.span(
+        "ckpt_shard_write", "io", round_idx=round_idx
+    ) as sp:
+        out = _write_framed(path, payload)
+        try:
+            sp["bytes"] = os.path.getsize(out)
+        except OSError:
+            pass
+    return out
 
 
-def write_manifest(directory: str, round_idx: int, manifest: dict) -> str:
+def write_manifest(directory: str, round_idx: int, manifest: dict,
+                   span_recorder=None) -> str:
     """Write the round's manifest (process 0, after the shard barrier).
 
     Atomic like the shards; its EXISTENCE is the round's commit record —
-    discovery only offers rounds whose manifest landed."""
+    discovery only offers rounds whose manifest landed. The optional
+    ``span_recorder`` journals the commit as a ``ckpt_manifest`` io
+    span."""
     import json
 
     manifest = dict(manifest)
     manifest["round"] = round_idx
     path = manifest_checkpoint_path(directory, round_idx)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, sort_keys=True)
-    os.replace(tmp, path)
-    return path
+
+    def _write() -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    if span_recorder is None:
+        return _write()
+    with span_recorder.span("ckpt_manifest", "io", round_idx=round_idx):
+        return _write()
 
 
 def manifest_rounds(directory: str) -> list[tuple[int, str]]:

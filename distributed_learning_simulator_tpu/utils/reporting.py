@@ -48,7 +48,11 @@ import json
 # count/shard bytes, the round's spill rows + bytes over DCN, and this
 # host's h2d/overlap; parallel/streaming.DistributedCohortStreamer —
 # attached only under client_residency='streamed' with >1 host
-# process). A record
+# process). v12 adds the ``spans`` sub-object (the distributed tracing
+# layer's per-round per-host summary: span/drop counts, per-category
+# seconds, DCN wait vs transfer, and the measured spill/checkpoint
+# barrier skews; telemetry/spans.py — attached only under
+# span_trace='on'). A record
 # is stamped with the LOWEST version that describes it:
 # telemetry_level='off' keeps emitting v1 byte-for-byte,
 # client_stats='off' keeps telemetry-only records at v2 byte-for-byte,
@@ -57,11 +61,13 @@ import json
 # keeps records at v5 or below, client_valuation='off' keeps
 # records at v6 or below, solo (non-sweep) runs keep records at v7
 # or below, population='static' keeps records at v8 or below,
-# serial (single-device) GTG walks keep records at v9 or below, and
-# single-process runs keep records at v10 or below —
+# serial (single-device) GTG walks keep records at v9 or below,
+# single-process runs keep records at v10 or below, and
+# span_trace='off' keeps records at v11 or below —
 # longitudinal tooling never sees a
 # layout change it didn't opt into.
-METRICS_SCHEMA_VERSION = 11
+METRICS_SCHEMA_VERSION = 12
+_MULTIHOST_SCHEMA_VERSION = 11
 _GTG_SCHEMA_VERSION = 10
 _POPULATION_SCHEMA_VERSION = 9
 _SWEEP_SCHEMA_VERSION = 8
@@ -110,6 +116,11 @@ _NON_PROGRAM_FIELDS = (
     "checkpoint_keep_last",
     "resume",
     "data_dir",
+    # Span-journal routing (telemetry/spans.py): where the per-host
+    # jsonl lands — pure I/O, never the measured program. The other
+    # span knobs off-gate out of the hash below instead (an ACTIVE
+    # trace adds instrumentation overhead to the measured round).
+    "span_dir",
     # Sweep persistence knobs (sweep/engine.py): where completed points
     # land and whether to resume — pure I/O, never the measured program.
     "sweep_dir",
@@ -126,7 +137,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
                        sweep: dict | None = None,
                        population: dict | None = None,
                        gtg: dict | None = None,
-                       multihost: dict | None = None) -> dict:
+                       multihost: dict | None = None,
+                       spans: dict | None = None) -> dict:
     """The ONE per-round metrics.jsonl record builder (vmap simulator and
     threaded oracle both write through this).
 
@@ -154,17 +166,23 @@ def build_round_record(base: dict, telemetry: dict | None = None,
     multihost dict (the distributed shard store's per-host assembly
     summary, parallel/streaming.DistributedCohortStreamer
     .multihost_record) upgrades it to v11 under the ``"multihost"``
-    key.
+    key; a spans dict (the distributed tracing layer's per-round
+    per-host summary, telemetry/spans.SpanRecorder.round_summary)
+    upgrades it to v12 under the ``"spans"`` key.
     """
     if telemetry is None and client_stats is None and (
         async_federation is None
     ) and stream is None and costmodel is None and valuation is None and (
         sweep is None
-    ) and population is None and gtg is None and multihost is None:
+    ) and population is None and gtg is None and multihost is None and (
+        spans is None
+    ):
         return base
     record = dict(base)
-    if multihost is not None:
+    if spans is not None:
         record["schema_version"] = METRICS_SCHEMA_VERSION
+    elif multihost is not None:
+        record["schema_version"] = _MULTIHOST_SCHEMA_VERSION
     elif gtg is not None:
         record["schema_version"] = _GTG_SCHEMA_VERSION
     elif population is not None:
@@ -203,6 +221,8 @@ def build_round_record(base: dict, telemetry: dict | None = None,
         record["gtg"] = gtg
     if multihost is not None:
         record["multihost"] = multihost
+    if spans is not None:
+        record["spans"] = spans
     return record
 
 
@@ -229,6 +249,14 @@ def config_hash(config) -> str:
             d.pop(k, None)
     if not d.get("gtg_cross_round_memo", False):
         d.pop("gtg_cross_round_memo", None)
+    if (d.get("span_trace") or "off").lower() == "off":
+        # Tracing off IS the pre-feature program (no spans, no journal,
+        # no extra DCN arrival stamps), so pre-feature configs keep
+        # their pre-feature hash; 'on' perturbs the measured round
+        # (instrumentation overhead + the arrival-stamp allgathers) and
+        # lands every span knob in the hash.
+        for k in ("span_trace", "span_buffer_size", "span_flush_last_k"):
+            d.pop(k, None)
     if (d.get("participation_sampler") or "exact").lower() == "exact":
         # 'exact' IS the pre-feature draw (ops/sampling.py), so
         # pre-feature configs keep their pre-feature hash; 'hashed'

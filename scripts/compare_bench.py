@@ -79,7 +79,12 @@ per-host placement) must keep the composed streamed x multihost run
 off the host-bound floor, never relatively tracked; armed like the gtg
 gate only on hosts with >= 2 usable cores (a 1-core cgroup cannot
 overlap two processes' compute — the honest number stays unarmed
-under ``mhost.cohort_rate``). The
+under ``mhost.cohort_rate``). The ``spans`` leg's ``overhead_ratio``
+(headline re-run with ``span_trace='on'``, telemetry/spans.py) gets
+``--span-overhead-threshold`` as an absolute ceiling, default 0.05 —
+the distributed tracer's promise is "cheap enough to leave on", and
+like the client-stats overhead the near-zero ratio is never relatively
+tracked. The
 ``costmodel`` leg's ``model_error_ratio`` per program (predicted /
 measured per-round ms from the roofline model, telemetry/costmodel.py)
 is judged as an absolute BAND around 1.0 (``--model-drift-threshold``,
@@ -447,6 +452,31 @@ def churn_overhead_gate(record: dict, threshold: float) -> dict | None:
     }
 
 
+def span_overhead_gate(record: dict, threshold: float) -> dict | None:
+    """In-record span-trace overhead gate: bench.py's ``spans`` leg
+    re-runs the headline program with ``span_trace='on'``
+    (telemetry/spans.py) and records the on-vs-off round-time
+    ``overhead_ratio`` within that single bench run. A ratio above
+    ``threshold`` means the recorder stopped being cheap enough to leave
+    on in production — a regression regardless of the old record.
+    Judged ABSOLUTELY (the PR 4/5 precedent: the ratio hovers near
+    zero, where relative changes are pure noise). None when the leg is
+    absent or the ceiling holds."""
+    ratio = get_path(record, "spans.overhead_ratio")
+    if ratio is None or ratio <= threshold:
+        return None
+    return {
+        "metric": "spans.overhead_ratio",
+        "description": (
+            "span_trace=on round-time overhead vs the same run's "
+            "off-mode headline (the distributed tracer must stay cheap "
+            "enough to leave on)"
+        ),
+        "old": threshold, "new": ratio,
+        "relative_change": None, "direction": "lower",
+    }
+
+
 def model_drift_gate(record: dict, threshold: float) -> list[dict]:
     """In-record cost-model drift gate: bench.py's ``costmodel`` leg
     records, per proxied program, the roofline model's predicted-vs-
@@ -564,6 +594,11 @@ def main(argv: list[str] | None = None) -> int:
                          "(default 0.10 — the 10x population-growth "
                          "registration stream must ride the round at "
                          "marginal cost)")
+    ap.add_argument("--span-overhead-threshold", type=float, default=0.05,
+                    help="max tolerated span_trace=on round-time overhead "
+                         "ratio in the NEW record's spans leg (default "
+                         "0.05 — the distributed tracer's cheap-enough-"
+                         "to-leave-on promise)")
     ap.add_argument("--model-drift-threshold", type=float, default=0.35,
                     help="max tolerated |model_error_ratio - 1| in the NEW "
                          "record's costmodel leg, per program (default "
@@ -602,6 +637,7 @@ def main(argv: list[str] | None = None) -> int:
         gtg_scaling_gate(new, args.gtg_scaling_threshold),
         churn_overhead_gate(new, args.churn_overhead_threshold),
         mhost_cohort_rate_gate(new, args.mhost_cohort_rate_threshold),
+        span_overhead_gate(new, args.span_overhead_threshold),
     ):
         if gate is not None:
             result["regressions"].append(gate)

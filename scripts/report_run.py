@@ -46,7 +46,17 @@ drift-cohort overlay against the v7 valuation top/bottom tables;
 robustness/population.py), and v10 (``gtg`` sub-object — the
 mesh-sharded GTG walk's per-round provenance; its audit-side face,
 wall seconds + device count, rides the v7 valuation audit line;
-algorithms/shapley.py). The only
+algorithms/shapley.py), and v11 (``multihost`` sub-object — the
+distributed shard store's per-host assembly provenance;
+parallel/streaming.py), and v12 (``spans`` sub-object — rendered as
+the distributed-trace section: per-round span counts, DCN wait vs
+transfer split, and the barrier-skew timeline; telemetry/spans.py).
+When ``spans_*.jsonl`` journals sit next to ``metrics.jsonl`` (or a
+shared ``span_dir`` is passed via ``--spans``), the cross-host
+timeline section is stitched live through ``scripts/trace_timeline.py``
+— per-host busy/wait totals, per-round barrier skew with the slowest
+host named, and the flight-recorder postmortem (what each host was
+doing when it died); ``--host`` restricts it to one host. The only
 heavy import (jax, via utils.tracing) is deferred behind ``--trace``,
 so metrics-only reporting is instant.
 """
@@ -60,6 +70,9 @@ import statistics
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import trace_timeline  # noqa: E402  (scripts/trace_timeline.py, jax-free)
 
 _SPARK = "▁▂▃▄▅▆▇█"
 
@@ -372,10 +385,55 @@ def summarize_population(records: list[dict]) -> dict | None:
     }
 
 
+def summarize_spans(records: list[dict]) -> dict | None:
+    """Aggregate schema-v12 ``spans`` sub-objects (span_trace='on',
+    telemetry/spans.py): run-total span counts and seconds by category,
+    the DCN wait-vs-transfer split, and the per-round barrier-skew
+    timeline (worst spill/checkpoint skew each round saw). None when no
+    record carries span data."""
+    sp = [
+        (r.get("round"), r["spans"]) for r in records
+        if isinstance(r.get("spans"), dict)
+    ]
+    if not sp:
+        return None
+    last = sp[-1][1]
+    by_cat: dict[str, float] = {}
+    for _, s in sp:
+        for cat, secs in (s.get("seconds_by_cat") or {}).items():
+            by_cat[cat] = by_cat.get(cat, 0.0) + secs
+    skew_timeline = [
+        {"round": rnd, "spill_skew_ms": s.get("spill_skew_ms"),
+         "ckpt_skew_ms": s.get("ckpt_skew_ms")}
+        for rnd, s in sp
+    ]
+    spills = [t["spill_skew_ms"] for t in skew_timeline
+              if t["spill_skew_ms"] is not None]
+    ckpts = [t["ckpt_skew_ms"] for t in skew_timeline
+             if t["ckpt_skew_ms"] is not None]
+    return {
+        "rounds_reported": len(sp),
+        "host_id": last.get("host_id"),
+        "hosts": last.get("hosts"),
+        "count": sum(int(s.get("count", 0)) for _, s in sp),
+        "dropped": sum(int(s.get("dropped", 0)) for _, s in sp),
+        "seconds_by_cat": {k: round(v, 6)
+                           for k, v in sorted(by_cat.items())},
+        "dcn_wait_s": round(
+            sum(s.get("dcn_wait_s", 0.0) for _, s in sp), 6),
+        "dcn_transfer_s": round(
+            sum(s.get("dcn_transfer_s", 0.0) for _, s in sp), 6),
+        "spill_skew_ms_max": max(spills) if spills else None,
+        "ckpt_skew_ms_max": max(ckpts) if ckpts else None,
+        "skew_timeline": skew_timeline,
+    }
+
+
 def summarize_run(records: list[dict], trace_stats: dict | None = None,
                   top_ops: list[dict] | None = None,
                   top_ops_time: list[dict] | None = None,
-                  costmodel: dict | None = None) -> dict:
+                  costmodel: dict | None = None,
+                  span_timeline: dict | None = None) -> dict:
     """Aggregate metrics records into the machine-readable summary the
     terminal renderer and ``--json`` output share."""
     if not records:
@@ -508,6 +566,13 @@ def summarize_run(records: list[dict], trace_stats: dict | None = None,
     mh_summary = summarize_multihost(records)
     if mh_summary is not None:
         summary["multihost"] = mh_summary
+
+    # --- spans sub-objects (schema v12, span_trace='on') --------------------
+    spans_summary = summarize_spans(records)
+    if spans_summary is not None:
+        summary["spans"] = spans_summary
+    if span_timeline is not None:
+        summary["span_timeline"] = span_timeline
 
     health = summarize_client_health(records)
     if health is not None:
@@ -691,6 +756,43 @@ def render_summary(summary: dict) -> list[str]:
             f"{m['dcn_bytes'] / 2**20:.2f} MiB over DCN, mean upload "
             f"overlap {m['mean_overlap_ratio']:.1%}"
         )
+    if "spans" in summary:
+        # Distributed-trace rollup (schema v12): the in-record view —
+        # what the spans sub-objects alone say, no journals needed.
+        sp = summary["spans"]
+        dropped = f", {sp['dropped']} dropped" if sp["dropped"] else ""
+        lines.append(
+            f"span trace: host {sp['host_id']}/{sp['hosts']}, "
+            f"{sp['count']} span(s) over {sp['rounds_reported']} "
+            f"round(s){dropped}; DCN wait {sp['dcn_wait_s']:.3f}s vs "
+            f"transfer {sp['dcn_transfer_s']:.3f}s"
+        )
+        skews = []
+        if sp["spill_skew_ms_max"] is not None:
+            skews.append(f"spill {sp['spill_skew_ms_max']:.3f} ms")
+        if sp["ckpt_skew_ms_max"] is not None:
+            skews.append(f"checkpoint {sp['ckpt_skew_ms_max']:.3f} ms")
+        if skews:
+            lines.append(
+                f"  worst barrier skew: {', '.join(skews)}"
+            )
+        spill_curve = [t["spill_skew_ms"] for t in sp["skew_timeline"]
+                       if t["spill_skew_ms"] is not None]
+        if len(spill_curve) > 1:
+            lines.append(
+                f"  spill skew/round: {sparkline(spill_curve)}  "
+                f"[{min(spill_curve):.2f} .. {max(spill_curve):.2f} ms]"
+            )
+    if "span_timeline" in summary:
+        # Cross-host view stitched from the spans_*.jsonl journals
+        # (scripts/trace_timeline.py): barrier skew with the slowest
+        # host named, per-host busy/wait split, and the flight-recorder
+        # postmortem — the section that answers "which HOST stalled".
+        lines.append("distributed trace (stitched span journals):")
+        for tl in trace_timeline.render_text(
+            summary["span_timeline"]
+        ).splitlines():
+            lines.append(f"  {tl}")
     if "compiles" in summary:
         c = summary["compiles"]
         lines.append(
@@ -1051,10 +1153,28 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--cost-rounds", type=int, default=None,
                     help="run horizon for the $/run projection (default: "
                          "this run's recorded round count)")
+    ap.add_argument("--spans", default=None,
+                    help="directory holding spans_*.jsonl host journals "
+                         "(default: the artifacts dir itself)")
+    ap.add_argument("--host", type=int, default=None,
+                    help="restrict the distributed-trace section to one "
+                         "host id")
     args = ap.parse_args(argv)
 
     try:
         records = load_metrics(args.artifacts)
+        span_timeline = None
+        span_dir = args.spans or (
+            args.artifacts if os.path.isdir(args.artifacts)
+            else os.path.dirname(args.artifacts)
+        )
+        journal_paths = trace_timeline.find_journals([span_dir]) \
+            if os.path.isdir(span_dir) else []
+        if journal_paths:
+            span_timeline = trace_timeline.summarize(
+                [trace_timeline.load_journal(p) for p in journal_paths],
+                host=args.host,
+            )
         trace_stats = top_ops = top_ops_time = costmodel = None
         if args.trace:
             # Deferred: utils.tracing imports jax. One gzip pass serves
@@ -1092,7 +1212,8 @@ def main(argv: list[str] | None = None) -> int:
                 )
         summary = summarize_run(records, trace_stats=trace_stats,
                                 top_ops=top_ops, top_ops_time=top_ops_time,
-                                costmodel=costmodel)
+                                costmodel=costmodel,
+                                span_timeline=span_timeline)
     except (FileNotFoundError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -637,3 +637,45 @@ def test_mhost_cohort_rate_self_gate(cb, tmp_path):
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0
+
+
+def test_span_overhead_not_relatively_tracked(cb):
+    """The span-trace overhead ratio hovers near zero like the
+    client-stats one: it must NOT be in the relative-change TRACKED
+    list; only the absolute ceiling below judges it."""
+    old = _record(spans={"overhead_ratio": 0.005})
+    new = _record(spans={"overhead_ratio": 0.03})  # within the gate
+    result = cb.compare_records(old, new, threshold=0.05)
+    assert not any(
+        "spans" in e["metric"]
+        for e in result["regressions"] + result["improvements"]
+    )
+
+
+def test_span_overhead_self_gate(cb, tmp_path):
+    """In-record absolute ceiling on the spans leg's on-vs-off round
+    time ratio (span_trace='on', telemetry/spans.py): the distributed
+    tracer must stay cheap enough to leave on."""
+    assert cb.span_overhead_gate(_record(), 0.05) is None  # leg absent
+    ok = _record(spans={"overhead_ratio": 0.018})
+    assert cb.span_overhead_gate(ok, 0.05) is None
+    bad = _record(spans={"overhead_ratio": 0.22})
+    entry = cb.span_overhead_gate(bad, 0.05)
+    assert entry and entry["new"] == 0.22 and entry["direction"] == "lower"
+
+    old_p = tmp_path / "old.json"
+    bad_p = tmp_path / "bad.json"
+    old_p.write_text(json.dumps(_record()))
+    bad_p.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "spans.overhead_ratio" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(old_p), str(bad_p),
+         "--span-overhead-threshold", "0.5"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0

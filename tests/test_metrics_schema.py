@@ -5,14 +5,14 @@ tests/data/metrics_record.schema.json is the reviewable contract every
 emitter (vmap simulator, threaded oracle, sweep engine) writes through
 ``build_round_record``. v1 (legacy), v2 (+telemetry), v3
 (+client_stats), v4 (+async), v5 (+stream), v6 (+costmodel), v7
-(+valuation), v8 (+sweep), v9 (+population), v10 (+gtg) and v11
-(+multihost) records must validate;
+(+valuation), v8 (+sweep), v9 (+population), v10 (+gtg), v11
+(+multihost) and v12 (+spans) records must validate;
 records that mix versions and sub-objects inconsistently must not. The
 integration tests in test_client_stats.py (test_costmodel.py for v6,
 test_valuation.py for v7, test_sweep.py for v8, test_population.py for
 v9, test_gtg_mesh.py for v10, test_multihost.py's 2-process harness
-for v11) validate REAL produced records against
-the same file.
+for v11 and v12 with span_trace='on') validate REAL produced records
+against the same file.
 """
 
 import json
@@ -24,6 +24,7 @@ import pytest
 from distributed_learning_simulator_tpu.utils.reporting import (
     METRICS_SCHEMA_VERSION,
     _GTG_SCHEMA_VERSION,
+    _MULTIHOST_SCHEMA_VERSION,
     build_round_record,
 )
 
@@ -412,7 +413,7 @@ def test_v11_record_validates():
         _base(), _telemetry(), None, None, _stream(),
         multihost=_multihost(),
     )
-    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 11
+    assert record["schema_version"] == _MULTIHOST_SCHEMA_VERSION == 11
     validate(record)
     # multihost alone (default telemetry) is still v11 — a distributed
     # streamed run with everything else off.
@@ -424,6 +425,43 @@ def test_v11_record_validates():
     validate(build_round_record(
         _base(),
         multihost={**_multihost(), "spill_rows": 0, "dcn_bytes": 0},
+    ))
+
+
+def _spans() -> dict:
+    return {
+        "host_id": 0,
+        "hosts": 2,
+        "count": 23,
+        "dropped": 0,
+        "seconds_by_cat": {"phase": 0.412, "dcn_wait": 0.031,
+                           "dcn": 0.004, "io": 0.009, "round": 0.46},
+        "dcn_wait_s": 0.031,
+        "dcn_transfer_s": 0.004,
+        "spill_skew_ms": 28.4,
+        "ckpt_skew_ms": None,
+    }
+
+
+def test_v12_record_validates():
+    record = build_round_record(
+        _base(), _telemetry(), None, None, _stream(),
+        multihost=_multihost(), spans=_spans(),
+    )
+    assert record["schema_version"] == METRICS_SCHEMA_VERSION == 12
+    validate(record)
+    # spans alone (every other feature off) is still v12 — a
+    # single-process span_trace='on' run; skews are null on rounds that
+    # crossed no barrier, and single-host runs report hosts=1.
+    validate(build_round_record(_base(), spans={
+        "host_id": 0, "hosts": 1, "count": 5,
+        "seconds_by_cat": {"phase": 0.01},
+        "dcn_wait_s": 0.0, "dcn_transfer_s": 0.0,
+        "spill_skew_ms": None, "ckpt_skew_ms": None,
+    }))
+    # A buffer-overrun round reports what it dropped.
+    validate(build_round_record(
+        _base(), spans={**_spans(), "dropped": 12},
     ))
 
 
@@ -449,6 +487,10 @@ def test_lowest_version_stamping_preserved():
         "schema_version"] == 9
     assert build_round_record(_base(), gtg=_gtg())[
         "schema_version"] == 10
+    assert build_round_record(_base(), multihost=_multihost())[
+        "schema_version"] == 11
+    assert build_round_record(_base(), spans=_spans())[
+        "schema_version"] == 12
 
 
 def test_version_content_mismatches_rejected():
@@ -635,6 +677,21 @@ def test_version_content_mismatches_rejected():
         )
         with pytest.raises(jsonschema.ValidationError):
             validate(bad)
+    # v11 stamp smuggling a spans sub-object (the builder always stamps
+    # span-trace records v12).
+    bad = build_round_record(_base(), multihost=_multihost())
+    bad["spans"] = _spans()
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # v12 stamp without the spans sub-object.
+    bad = build_round_record(_base(), _telemetry())
+    bad["schema_version"] = 12
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
+    # Unknown spans keys are schema breaks, not silent extensions.
+    bad = build_round_record(_base(), spans={**_spans(), "mystery": 1})
+    with pytest.raises(jsonschema.ValidationError):
+        validate(bad)
 
 
 def test_missing_required_base_fields_rejected():

@@ -365,6 +365,9 @@ _STREAM_CODE = textwrap.dedent("""
     from distributed_learning_simulator_tpu.simulator import run_simulation
 
     extra = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
+    # The span tests need the primary's artifacts dir (metrics.jsonl
+    # with v12 records), which only materializes under setup_logging.
+    setup = extra.pop("setup_logging", False)
     config = ExperimentConfig(
         dataset_name="synthetic", model_name="mlp",
         distributed_algorithm=extra.pop("distributed_algorithm", "fed"),
@@ -376,7 +379,7 @@ _STREAM_CODE = textwrap.dedent("""
         client_residency="streamed", **extra,
     )
     try:
-        res = run_simulation(config, setup_logging=False)
+        res = run_simulation(config, setup_logging=setup)
     except RuntimeError as e:
         # The topology-mismatch variant expects a cause-named refusal.
         print("REFUSED", sys.argv[2], str(e)[:200].replace("\\n", " "))
@@ -390,6 +393,8 @@ _STREAM_CODE = textwrap.dedent("""
     ]
     print("HIST", sys.argv[2], json.dumps(keep))
     print("MHSUM", sys.argv[2], json.dumps(res["multihost_summary"]))
+    if res.get("span_summary") is not None:
+        print("SPANSUM", sys.argv[2], json.dumps(res["span_summary"]))
 """)
 
 _STATEFUL = {
@@ -597,3 +602,111 @@ def test_single_process_resume_of_sharded_dir_refused(tmp_path):
     )
     with pytest.raises(RuntimeError, match="sharded checkpoints"):
         run_simulation(cfg, setup_logging=False)
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing (telemetry/spans.py + scripts/trace_timeline.py):
+# the REAL 2-process acceptance runs — a deliberately slowed host named
+# by the stitched timeline, and a SIGKILL postmortem naming both hosts'
+# in-flight spans. The arithmetic of the stitcher itself is pinned by
+# the synthetic-journal tests in tests/test_spans.py.
+
+
+def _load_stitcher():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "trace_timeline.py")
+    spec = importlib.util.spec_from_file_location("trace_timeline", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_two_process_span_trace_straggler_attribution(tmp_path):
+    """Slow ONE host's arrival at the spill exchange (DLS_STRAGGLE_S)
+    with span_trace on: the stitched journals must attribute every
+    spill barrier to the slowed host, measure a skew of the order of
+    the injected delay, show the FAST host carrying the wait time, and
+    the primary's metrics.jsonl must stamp schema v12 with the same
+    skew — while the run itself still matches SPMD across hosts."""
+    import glob
+    import json
+
+    span_dir = str(tmp_path / "spans")
+    art = str(tmp_path / "art")
+    outs = _stream_two(
+        dict(_STATEFUL, span_trace="on", span_dir=span_dir, log_root=art,
+             setup_logging=True),
+        env_overrides=(None, {"DLS_STRAGGLE_S": "0.2"}),
+    )
+    assert _hist_of(outs[0]) == _hist_of(outs[1])
+    # Both hosts return a run-total span summary in the result dict.
+    sums = {}
+    for out in outs:
+        ln = [ln for ln in out.splitlines() if ln.startswith("SPANSUM")][0]
+        s = json.loads(ln.split(" ", 2)[2])
+        sums[s["host_id"]] = s
+    assert set(sums) == {0, 1}
+    assert all(s["count"] > 0 for s in sums.values())
+    assert sums[0]["spill_skew_ms_max"] > 100.0  # ~200 ms injected
+
+    tt = _load_stitcher()
+    journals = [tt.load_journal(p)
+                for p in tt.find_journals([span_dir])]
+    assert [j["header"]["host_id"] for j in journals] == [0, 1]
+    summary = tt.summarize(journals)
+    spill = [entry for rnd in summary["rounds"].values()
+             for name, entry in rnd.items() if name == "spill_wait"]
+    assert spill, summary["rounds"]
+    # The straggler arrived last at EVERY barrier => shortest wait.
+    assert all(e["slowest_host"] == 1 for e in spill), spill
+    assert max(e["skew_ms"] for e in spill) > 100.0, spill
+    # ...and the fast host is the one that accumulated the DCN wait.
+    assert (summary["totals"]["0"]["dcn_wait_s"]
+            > summary["totals"]["1"]["dcn_wait_s"]), summary["totals"]
+
+    # Primary's records: v12-stamped, spans sub-object carrying the skew.
+    mfiles = glob.glob(os.path.join(art, "**", "metrics.jsonl"),
+                       recursive=True)
+    assert mfiles, os.listdir(art)
+    recs = [json.loads(ln) for ln in open(mfiles[0])]
+    assert recs and all(r["schema_version"] == 12 for r in recs)
+    skews = [r["spans"].get("spill_skew_ms") for r in recs]
+    assert any(s is not None and s > 100.0 for s in skews), skews
+
+
+def test_two_process_span_flight_recorder_sigkill_postmortem(tmp_path):
+    """SIGKILL one host mid-run with span_trace on: no cleanup code runs
+    on the victim, yet the stitched postmortem names BOTH hosts'
+    in-flight spans — the victim via the eager open-line of the round
+    envelope it died inside, the survivor via its crash flush (or its
+    own eager open-line if it too dies hard on the broken collective)."""
+    span_dir = str(tmp_path / "spans")
+    outs = _stream_two(
+        dict(_STATEFUL, round=4, span_trace="on", span_dir=span_dir),
+        env_overrides=(None, {"DLS_CRASH_AT_ROUND": "1",
+                              "DLS_CRASH_KIND": "sigkill"}),
+        expect_rc=False,
+    )
+    assert any(rc != 0 for rc, _, _ in outs), outs
+
+    tt = _load_stitcher()
+    journals = [tt.load_journal(p)
+                for p in tt.find_journals([span_dir])]
+    assert len(journals) == 2, [j["path"] for j in journals]
+    postmortem = tt.summarize(journals)["postmortem"]
+    by_host: dict[int, list] = {}
+    for p in postmortem:
+        by_host.setdefault(p["host_id"], []).append(p)
+    assert set(by_host) == {0, 1}, postmortem
+    # Victim (host 1): maybe_crash fires inside the eager 'finalize'
+    # envelope, so its journal's unmatched open names that span.
+    assert any(
+        p.get("name") == "finalize"
+        and p["kind"] in ("died_inside", "inflight")
+        for p in by_host[1]
+    ), postmortem
+    # Survivor (host 0): whatever way it went down, a NAMED span marks
+    # where it was stuck when the federation broke.
+    assert any(p.get("name") for p in by_host[0]), postmortem
